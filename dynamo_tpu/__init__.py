@@ -1,0 +1,18 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+Provides the capabilities of NVIDIA Dynamo (reference: /root/reference — an
+orchestrator of GPU engines: OpenAI frontend, KV-aware routing, tiered KV block
+management, disaggregated prefill/decode, SLA planner) re-designed TPU-first:
+
+- ``dynamo_tpu.runtime``   — distributed runtime: component model, discovery,
+  leases, request push routing, TCP response plane (ref: lib/runtime/).
+- ``dynamo_tpu.llm``       — LLM serving library: OpenAI protocols + HTTP
+  frontend, preprocessor, KV router, KV block manager, disaggregation,
+  migration (ref: lib/llm/).
+- ``dynamo_tpu.engine``    — the native JAX/XLA/Pallas engine (the part the
+  reference outsources to vLLM/SGLang/TRT-LLM): paged attention, continuous
+  batching, TP/EP/SP over jax.sharding meshes.
+- ``dynamo_tpu.planner``   — SLA/load autoscaling planner (ref: components/planner).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,41 @@
+"""Run the built-in control-plane broker: ``python -m dynamo_tpu.control_plane``.
+
+Plays the roles etcd + NATS play for the reference (discovery/leases +
+messaging/streams/object store) as a single zero-dependency process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.runtime.logging import init_logging
+from dynamo_tpu.runtime.transports.tcp_control import ControlPlaneServer
+
+
+async def amain(host: str, port: int) -> None:
+    server = ControlPlaneServer(host=host, port=port)
+    await server.start()
+    print(f"control plane ready on {server.host}:{server.port}", flush=True)
+    try:
+        await server.serve_forever()
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await server.close()
+
+
+def main() -> None:
+    init_logging()
+    parser = argparse.ArgumentParser(description="dynamo-tpu built-in control plane (etcd+NATS role)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=6650)
+    args = parser.parse_args()
+    try:
+        asyncio.run(amain(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

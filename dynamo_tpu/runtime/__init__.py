@@ -1,0 +1,41 @@
+"""Distributed runtime: the TPU-native equivalent of the reference's
+``lib/runtime`` (Rust, ~30k LoC — SURVEY.md §2a).
+
+Key exports:
+- :class:`Runtime` / :class:`DistributedRuntime` — process + cluster handles.
+- ``Namespace`` → ``Component`` → ``Endpoint`` hierarchy with instance
+  discovery via a watched key-value store (the etcd role).
+- :class:`AsyncEngine` protocol and :class:`Context` (request id, cancellation,
+  tracing) — ref: lib/runtime/src/engine.rs:201, pipeline/context.rs.
+- :class:`PushRouter` — client-side routing (round-robin / random / direct /
+  KV) — ref: lib/runtime/src/pipeline/network/egress/push_router.rs:33.
+"""
+
+from dynamo_tpu.runtime.engine import (
+    AsyncEngine,
+    Context,
+    EngineStream,
+    annotated,
+)
+from dynamo_tpu.runtime.runtime import Runtime
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.component import Namespace, Component, Endpoint, Instance
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.runtime.decorators import dynamo_worker, dynamo_endpoint
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "EngineStream",
+    "annotated",
+    "Runtime",
+    "DistributedRuntime",
+    "Namespace",
+    "Component",
+    "Endpoint",
+    "Instance",
+    "PushRouter",
+    "RouterMode",
+    "dynamo_worker",
+    "dynamo_endpoint",
+]

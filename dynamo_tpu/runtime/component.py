@@ -1,0 +1,310 @@
+"""Namespace → Component → Endpoint hierarchy with lease-bound discovery.
+
+Ref: lib/runtime/src/component.rs — roots :75-78, ``Component`` :120,
+``Endpoint`` :358, ``subject_to`` :492-503, ``Namespace`` :520, ``Instance``
+:98; component/endpoint.rs (EndpointConfigBuilder → serving), component/
+service.rs.
+
+Discovery contract (identical to the reference's):
+- instance key   ``instances/{ns}/{comp}/{ep}:{lease_id:x}`` → Instance JSON,
+  bound to the worker's lease (lease lapse ⇒ key vanishes ⇒ routers prune).
+- request subject ``rq.{ns}.{comp}.{ep}.{lease_id:x}`` — one subject per
+  instance; the push router publishes requests here with TCP call-home info.
+- control subject ``ctl.{ns}.{comp}.{ep}.{lease_id:x}`` — cancellation et al.
+- stats subject   ``stats.{ns}.{comp}.{ep}.{lease_id:x}`` — request/reply
+  stats scrape (ref: component.rs:280-334 NATS service stats).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, Optional, TYPE_CHECKING
+
+import msgpack
+
+from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpCallHome
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+logger = get_logger(__name__)
+
+INSTANCE_ROOT = "instances"
+
+
+def sanitize(token: str) -> str:
+    return token.replace(".", "_").replace("/", "_")
+
+
+@dataclass(frozen=True)
+class Instance:
+    """A live endpoint instance (ref: component.rs:98)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int  # the lease id
+
+    @property
+    def etcd_key(self) -> str:
+        return f"{INSTANCE_ROOT}/{self.namespace}/{self.component}/{self.endpoint}:{self.instance_id:x}"
+
+    @property
+    def subject(self) -> str:
+        return f"rq.{sanitize(self.namespace)}.{sanitize(self.component)}.{sanitize(self.endpoint)}.{self.instance_id:x}"
+
+    @property
+    def control_subject(self) -> str:
+        return f"ctl.{sanitize(self.namespace)}.{sanitize(self.component)}.{sanitize(self.endpoint)}.{self.instance_id:x}"
+
+    @property
+    def stats_subject(self) -> str:
+        return f"stats.{sanitize(self.namespace)}.{sanitize(self.component)}.{sanitize(self.endpoint)}.{self.instance_id:x}"
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "namespace": self.namespace,
+                "component": self.component,
+                "endpoint": self.endpoint,
+                "instance_id": self.instance_id,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Instance":
+        d = json.loads(raw)
+        return cls(
+            namespace=d["namespace"],
+            component=d["component"],
+            endpoint=d["endpoint"],
+            instance_id=int(d["instance_id"]),
+        )
+
+
+class Namespace:
+    def __init__(self, drt: "DistributedRuntime", name: str):
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self.drt, self.name, name)
+
+
+class Component:
+    def __init__(self, drt: "DistributedRuntime", namespace: str, name: str):
+        self.drt = drt
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.drt, self.namespace, self.name, name)
+
+    async def create_service(self) -> None:
+        """No-op placeholder kept for API parity with the reference's NATS
+        service creation (service registration happens per-endpoint here)."""
+        return None
+
+    @property
+    def instance_prefix(self) -> str:
+        return f"{INSTANCE_ROOT}/{self.namespace}/{self.name}/"
+
+
+class ServeHandle:
+    """A running endpoint instance: owns the lease keepalive + ingress loop."""
+
+    def __init__(self, endpoint: "Endpoint", instance: Instance, lease, tasks):
+        self.endpoint = endpoint
+        self.instance = instance
+        self.lease = lease
+        self._tasks = tasks
+        self._stopped = False
+
+    async def stop(self, *, drain: bool = True) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        drt = self.endpoint.drt
+        # Deregister first so routers stop sending, then drain, then drop tasks.
+        await drt.store.delete(self.instance.etcd_key)
+        drt.local_engines.pop(self.instance.instance_id, None)
+        if drain:
+            await drt.runtime.shutdown_tracker.wait_drained(drt.runtime.config.runtime.shutdown_timeout_s)
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.lease.revoke()
+
+
+class Endpoint:
+    """An addressable unit of work (ref: component.rs:358)."""
+
+    def __init__(self, drt: "DistributedRuntime", namespace: str, component: str, name: str):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.name}"
+
+    @property
+    def instance_prefix(self) -> str:
+        return f"{INSTANCE_ROOT}/{self.namespace}/{self.component}/{self.name}:"
+
+    async def client(self, **kwargs) -> "Client":
+        from dynamo_tpu.runtime.client import Client
+
+        client = Client(self)
+        await client.start(**kwargs)
+        return client
+
+    async def serve_endpoint(
+        self,
+        handler: AsyncEngine | Callable[[Any, Context], AsyncIterator[Any]],
+        *,
+        stats_handler: Optional[Callable[[], dict]] = None,
+        graceful_shutdown: bool = True,
+        lease_ttl_s: Optional[float] = None,
+    ) -> ServeHandle:
+        """Register and serve this endpoint (ref: component/endpoint.rs
+        EndpointConfigBuilder.start).
+
+        ``handler`` is an AsyncEngine or a bare async-generator function
+        ``(request, context) -> AsyncIterator``.
+        """
+        drt = self.drt
+        engine = handler if isinstance(handler, AsyncEngine) else _FnEngine(handler)
+        ttl = lease_ttl_s if lease_ttl_s is not None else drt.config.control_plane.lease_ttl_s
+        lease = await drt.store.grant_lease(ttl)
+        drt.spawn_lease_keepalive(lease)
+        instance = Instance(self.namespace, self.component, self.name, lease.id)
+
+        ingress = _PushEndpoint(drt, instance, engine, graceful_shutdown=graceful_shutdown)
+        tasks = await ingress.start(stats_handler=stats_handler)
+
+        # In-process fast path: callers in this process bypass pub/sub + TCP.
+        drt.local_engines[instance.instance_id] = engine
+
+        # Register last: the instance only becomes routable once it can serve.
+        await drt.store.put(instance.etcd_key, instance.to_json(), lease_id=lease.id)
+        logger.info("serving endpoint %s as instance %x", self.path, lease.id)
+        handle = ServeHandle(self, instance, lease, tasks)
+        drt.serve_handles.append(handle)
+        return handle
+
+
+class _FnEngine:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def generate(self, request, context):
+        return self._fn(request, context)
+
+
+class _PushEndpoint:
+    """Worker-side ingress loop (ref: pipeline/network/ingress/push_endpoint.rs:21-164,
+    push_handler.rs). Consumes pushed requests, runs the handler, streams
+    responses back over the TCP call-home channel."""
+
+    def __init__(self, drt: "DistributedRuntime", instance: Instance, engine: AsyncEngine, graceful_shutdown: bool):
+        self.drt = drt
+        self.instance = instance
+        self.engine = engine
+        self.graceful_shutdown = graceful_shutdown
+        self.in_flight: Dict[str, Context] = {}
+
+        self._request_tasks: set = set()
+
+    async def start(self, stats_handler=None) -> list:
+        sub = await self.drt.bus.subscribe(self.instance.subject)
+        ctl = await self.drt.bus.subscribe(self.instance.control_subject)
+        stats_sub = await self.drt.bus.subscribe(self.instance.stats_subject)
+        loop = asyncio.get_running_loop()
+        tasks = [
+            loop.create_task(self._ingress_loop(sub), name=f"ingress-{self.instance.instance_id:x}"),
+            loop.create_task(self._control_loop(ctl), name=f"ctl-{self.instance.instance_id:x}"),
+            loop.create_task(self._stats_loop(stats_sub, stats_handler), name=f"stats-{self.instance.instance_id:x}"),
+        ]
+        return tasks
+
+    async def _ingress_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                payload = msgpack.unpackb(msg.data, raw=False)
+            except Exception:
+                # A malformed message must never kill the ingress loop — the
+                # instance would stay registered but unreachable.
+                logger.warning("dropping malformed request on %s", self.instance.subject)
+                continue
+            task = asyncio.get_running_loop().create_task(self._handle(payload))
+            # Hold a strong reference: the loop keeps only weak refs to tasks.
+            self._request_tasks.add(task)
+            task.add_done_callback(self._request_tasks.discard)
+
+    async def _control_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                payload = msgpack.unpackb(msg.data, raw=False)
+            except Exception:
+                continue
+            if payload.get("op") == "cancel":
+                ctx = self.in_flight.get(payload.get("request_id", ""))
+                if ctx is not None:
+                    logger.info("cancel received for request %s", payload.get("request_id"))
+                    ctx.kill()
+
+    async def _stats_loop(self, sub, stats_handler) -> None:
+        async for msg in sub:
+            if msg.reply_to:
+                data = {"in_flight": len(self.in_flight)}
+                if stats_handler is not None:
+                    try:
+                        data.update(stats_handler() or {})
+                    except Exception as e:  # stats must never break serving
+                        data["stats_error"] = str(e)
+                await self.drt.bus.publish(msg.reply_to, msgpack.packb(data, use_bin_type=True))
+
+    async def _handle(self, payload: dict) -> None:
+        ctx = Context.from_wire(payload.get("ctx", {}))
+        conn = payload.get("conn")
+        request = payload.get("request")
+        self.in_flight[ctx.id] = ctx
+        tracker = self.drt.runtime.shutdown_tracker
+        if self.graceful_shutdown:
+            tracker.enter()
+        call_home: Optional[TcpCallHome] = None
+        try:
+            call_home = TcpCallHome(ConnectionInfo.from_dict(conn))
+            ok = await call_home.connect()
+            if not ok:
+                return  # caller is gone; drop the request
+            try:
+                async for item in self.engine.generate(request, ctx):
+                    if ctx.is_killed():
+                        break
+                    wire = item.to_wire() if isinstance(item, Annotated) else {"data": item}
+                    await call_home.send(wire)
+                if ctx.is_killed():
+                    await call_home.error("request cancelled")
+                else:
+                    await call_home.complete()
+            except Exception as e:
+                logger.exception("handler error for request %s", ctx.id)
+                try:
+                    await call_home.error(f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+        except ConnectionError:
+            logger.warning("call-home connection failed for request %s", ctx.id)
+        finally:
+            if call_home is not None:
+                await call_home.close()
+            self.in_flight.pop(ctx.id, None)
+            if self.graceful_shutdown:
+                tracker.exit()

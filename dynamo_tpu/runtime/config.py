@@ -1,0 +1,96 @@
+"""Layered runtime configuration from environment variables.
+
+TPU-native equivalent of the reference's figment-based config
+(ref: lib/runtime/src/config.rs:66-180 — env ``DYN_RUNTIME_*``,
+``DYN_SYSTEM_*``, ``DYN_WORKER_*``). We keep the same env-var surface so
+operator tooling translates directly.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v is not None else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-level runtime knobs (ref: config.rs RuntimeConfig)."""
+
+    # Worker thread pool sizing (maps to asyncio executor workers here).
+    num_worker_threads: int = field(default_factory=lambda: _env_int("DYN_RUNTIME_NUM_WORKER_THREADS", 4))
+    max_blocking_threads: int = field(default_factory=lambda: _env_int("DYN_RUNTIME_MAX_BLOCKING_THREADS", 16))
+    # Graceful-shutdown drain timeout in seconds.
+    shutdown_timeout_s: float = field(default_factory=lambda: _env_float("DYN_RUNTIME_SHUTDOWN_TIMEOUT", 30.0))
+
+
+@dataclass
+class SystemConfig:
+    """System status server config (ref: config.rs:85-123 DYN_SYSTEM_*)."""
+
+    enabled: bool = field(default_factory=lambda: _env_bool("DYN_SYSTEM_ENABLED", False))
+    port: int = field(default_factory=lambda: _env_int("DYN_SYSTEM_PORT", 0))
+    host: str = field(default_factory=lambda: _env_str("DYN_SYSTEM_HOST", "0.0.0.0"))
+    # When true, /health reflects per-endpoint health rather than process liveness
+    # (ref: DYN_SYSTEM_USE_ENDPOINT_HEALTH_STATUS config.rs:112).
+    use_endpoint_health_status: bool = field(
+        default_factory=lambda: _env_bool("DYN_SYSTEM_USE_ENDPOINT_HEALTH_STATUS", False)
+    )
+    starting_health_status: str = field(default_factory=lambda: _env_str("DYN_SYSTEM_STARTING_HEALTH_STATUS", "notready"))
+
+
+@dataclass
+class ControlPlaneConfig:
+    """Where the control plane (KV store + pubsub — the etcd/NATS role) lives.
+
+    ``mem`` — in-process (single-process deployments and tests).
+    ``tcp`` — the built-in control-plane server (``python -m dynamo_tpu.control_plane``).
+    """
+
+    backend: str = field(default_factory=lambda: _env_str("DYN_CONTROL_PLANE", "mem"))
+    address: str = field(default_factory=lambda: _env_str("DYN_CONTROL_PLANE_ADDRESS", "127.0.0.1:6650"))
+    lease_ttl_s: float = field(default_factory=lambda: _env_float("DYN_CONTROL_PLANE_LEASE_TTL", 10.0))
+
+
+@dataclass
+class Config:
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    system: SystemConfig = field(default_factory=SystemConfig)
+    control_plane: ControlPlaneConfig = field(default_factory=ControlPlaneConfig)
+    namespace: str = field(default_factory=lambda: _env_str("DYN_NAMESPACE", "dynamo"))
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls()
+
+
+def config_overview(cfg: Config) -> dict:
+    """Flatten a Config to a dict for logging/diagnostics."""
+    out: dict = {}
+    for f in fields(cfg):
+        v = getattr(cfg, f.name)
+        if hasattr(v, "__dataclass_fields__"):
+            out[f.name] = {g.name: getattr(v, g.name) for g in fields(v)}
+        else:
+            out[f.name] = v
+    return out

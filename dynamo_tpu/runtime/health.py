@@ -1,0 +1,96 @@
+"""System status server: /health, /live, /metrics.
+
+Ref: lib/runtime/src/system_status_server.rs:20-705 (axum server) and
+SystemHealth in lib.rs:81-174 — endpoint-level health states, configured via
+``DYN_SYSTEM_*`` (config.rs:85-123).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.config import SystemConfig
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+logger = get_logger(__name__)
+
+HEALTHY = "ready"
+UNHEALTHY = "notready"
+
+
+class SystemHealth:
+    """Tracks process + per-endpoint health (ref: lib.rs:81-174)."""
+
+    def __init__(self, starting_status: str = UNHEALTHY, use_endpoint_health: bool = False):
+        self.system_status = starting_status
+        self.use_endpoint_health = use_endpoint_health
+        self.endpoints: Dict[str, str] = {}
+
+    def set_system_ready(self) -> None:
+        self.system_status = HEALTHY
+
+    def set_endpoint_health(self, endpoint_path: str, status: str) -> None:
+        self.endpoints[endpoint_path] = status
+
+    def remove_endpoint(self, endpoint_path: str) -> None:
+        self.endpoints.pop(endpoint_path, None)
+
+    def is_healthy(self) -> bool:
+        if self.use_endpoint_health:
+            return bool(self.endpoints) and all(s == HEALTHY for s in self.endpoints.values())
+        return self.system_status == HEALTHY
+
+    def snapshot(self) -> dict:
+        return {
+            "status": HEALTHY if self.is_healthy() else UNHEALTHY,
+            "system": self.system_status,
+            "endpoints": dict(self.endpoints),
+        }
+
+
+class SystemStatusServer:
+    def __init__(
+        self,
+        health: SystemHealth,
+        metrics: Optional[MetricsRegistry] = None,
+        config: Optional[SystemConfig] = None,
+    ):
+        self.health = health
+        self.metrics = metrics
+        self.config = config or SystemConfig()
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.host, self.config.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        logger.info("system status server on %s:%d", self.config.host, self.port)
+
+    async def _health(self, request: web.Request) -> web.Response:
+        snap = self.health.snapshot()
+        status = 200 if snap["status"] == HEALTHY else 503
+        return web.Response(status=status, text=json.dumps(snap), content_type="application/json")
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.Response(status=200, text=json.dumps({"status": "live"}), content_type="application/json")
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body = self.metrics.render() if self.metrics is not None else b""
+        return web.Response(status=200, body=body, content_type="text/plain")
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,164 @@
+"""Client-side request routing over live instances.
+
+Ref: lib/runtime/src/pipeline/network/egress/push_router.rs:33-275
+(``RouterMode`` :71 — round_robin :138 / random :159 / direct :179 / static
+:197, busy-threshold gating via WorkerMonitor) and egress/addressed_router.rs
+(two-part wire: publish request over pub/sub with TCP call-home info; response
+frames return over TCP).
+
+The KV-aware mode lives in ``dynamo_tpu.llm.kv_router`` and wraps this router
+with a scheduler-chosen ``instance_id`` (the reference's KvPushRouter does the
+same around PushRouter.direct).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import random
+from typing import Any, AsyncIterator, Optional, Set
+
+import msgpack
+
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.engine import Annotated, Context, StreamDisconnect
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RouterMode(enum.Enum):
+    ROUND_ROBIN = "round-robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class NoInstancesError(Exception):
+    pass
+
+
+class WorkerMonitor:
+    """Tracks per-worker busy state from published load metrics
+    (ref: utils/worker_monitor.rs:34-190 — busy when kv-cache usage exceeds
+    the threshold). Fed by ForwardPassMetrics via the metrics subscriber."""
+
+    def __init__(self, busy_threshold: Optional[float] = None):
+        self.busy_threshold = busy_threshold
+        self._usage: dict[int, float] = {}
+
+    def update(self, instance_id: int, kv_usage: float) -> None:
+        self._usage[instance_id] = kv_usage
+
+    def busy_instances(self) -> Set[int]:
+        if self.busy_threshold is None:
+            return set()
+        return {i for i, u in self._usage.items() if u >= self.busy_threshold}
+
+
+class PushRouter:
+    """Routes requests to endpoint instances; returns the response stream."""
+
+    def __init__(
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        *,
+        monitor: Optional[WorkerMonitor] = None,
+    ):
+        self.client = client
+        self.drt = client.drt
+        self.mode = mode
+        self.monitor = monitor or WorkerMonitor()
+        self._rr = 0
+
+    # --- instance selection -------------------------------------------------
+    def _candidates(self) -> list[int]:
+        ids = self.client.instance_ids()
+        if not ids:
+            raise NoInstancesError(f"no instances for {self.client.endpoint.path}")
+        busy = self.monitor.busy_instances()
+        free = [i for i in ids if i not in busy]
+        return free or ids  # all busy ⇒ degrade to full set rather than fail
+
+    def select(self, instance_id: Optional[int] = None) -> int:
+        if instance_id is not None:
+            if instance_id not in self.client.instances:
+                raise NoInstancesError(f"instance {instance_id:x} not found for {self.client.endpoint.path}")
+            return instance_id
+        ids = self._candidates()
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(ids)
+        # default round-robin
+        chosen = ids[self._rr % len(ids)]
+        self._rr += 1
+        return chosen
+
+    # --- request paths ------------------------------------------------------
+    async def generate(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        *,
+        instance_id: Optional[int] = None,
+    ) -> AsyncIterator[Annotated]:
+        """Push the request to a selected instance and yield response frames.
+
+        Raises :class:`StreamDisconnect` if the stream drops mid-flight, which
+        the Migration operator upstream turns into a replay on another worker.
+        """
+        ctx = context or Context()
+        chosen = self.select(instance_id)
+        instance = self.client.instances[chosen]
+
+        local = self.drt.local_engines.get(chosen)
+        if local is not None:
+            # In-process fast path: skip pub/sub + TCP entirely.
+            async for item in self._generate_local(local, request, ctx):
+                yield item
+            return
+
+        conn_info, pending = self.drt.tcp_server_handle().register()
+        payload = msgpack.packb(
+            {"request": request, "ctx": ctx.to_wire(), "conn": conn_info.to_dict()},
+            use_bin_type=True,
+        )
+        await self.drt.bus.publish(instance.subject, payload)
+
+        cancelled_sent = False
+        try:
+            async for frame in pending.frames():
+                if ctx.is_stopped() and not cancelled_sent:
+                    cancelled_sent = True
+                    await self.drt.bus.publish(
+                        instance.control_subject,
+                        msgpack.packb({"op": "cancel", "request_id": ctx.id}, use_bin_type=True),
+                    )
+                if frame.kind == "prologue":
+                    continue
+                if frame.kind == "data":
+                    yield Annotated.from_wire(frame.header)
+                elif frame.kind == "complete":
+                    return
+                elif frame.kind == "error":
+                    if frame.header.get("disconnect"):
+                        raise StreamDisconnect(frame.header.get("message", "disconnect"))
+                    raise RuntimeError(frame.header.get("message", "engine error"))
+        finally:
+            self.drt.tcp_server_handle().unregister(conn_info.stream_id)
+
+    async def _generate_local(self, engine, request, ctx) -> AsyncIterator[Annotated]:
+        async for item in engine.generate(request, ctx):
+            yield item if isinstance(item, Annotated) else Annotated(data=item)
+
+    # convenience wrappers matching the reference's API surface
+    async def round_robin(self, request, context=None):
+        self.mode = RouterMode.ROUND_ROBIN
+        return self.generate(request, context)
+
+    async def random(self, request, context=None):
+        self.mode = RouterMode.RANDOM
+        return self.generate(request, context)
+
+    async def direct(self, request, instance_id: int, context=None):
+        return self.generate(request, context, instance_id=instance_id)

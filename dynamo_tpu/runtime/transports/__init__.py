@@ -1,0 +1,24 @@
+"""Transports: the control plane (key-value store with leases/watches — the
+etcd role; pub/sub messaging — the NATS role) and the data plane (TCP response
+streaming). Ref: lib/runtime/src/transports/{etcd,nats,zmq,tcp}.rs.
+
+All control-plane users program against the abstract :class:`KvStore` /
+:class:`PubSub` interfaces; deployments choose:
+
+- in-memory (single process, unit tests — ref: storage/key_value_store/mem.rs)
+- the built-in TCP control-plane server (multi-process / multi-host)
+"""
+
+from dynamo_tpu.runtime.transports.kvstore import KvStore, MemKvStore, Lease, WatchEvent, EventType
+from dynamo_tpu.runtime.transports.pubsub import PubSub, MemPubSub, Message
+
+__all__ = [
+    "KvStore",
+    "MemKvStore",
+    "Lease",
+    "WatchEvent",
+    "EventType",
+    "PubSub",
+    "MemPubSub",
+    "Message",
+]

@@ -1,0 +1,34 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so sharding tests
+run anywhere (SURVEY.md §4 — the reference runs distributed tests against
+mockers + local etcd/NATS; we run against in-memory control plane + CPU mesh).
+
+Must set env before jax initializes a backend.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DYN_LOG", "WARNING")
+
+import asyncio
+import functools
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run coroutine test functions via asyncio.run (no pytest-asyncio here)."""
+    for item in items:
+        if asyncio.iscoroutinefunction(getattr(item, "function", None)):
+            item.obj = _sync(item.function)
+
+
+def _sync(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+
+    return wrapper

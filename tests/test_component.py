@@ -1,0 +1,174 @@
+"""Integration tests for the component model: serve → discover → route →
+stream, plus cancellation and worker-death handling. These exercise the real
+TCP call-home data plane even though the control plane is in-memory (mirrors
+the reference's mocker-based distributed tests, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+    RouterMode,
+)
+from dynamo_tpu.runtime.engine import StreamDisconnect
+
+
+async def _echo_handler(request, context):
+    for i in range(int(request.get("n", 3))):
+        yield {"i": i, "msg": request.get("msg", "")}
+
+
+async def make_drt():
+    return await DistributedRuntime.detached()
+
+
+async def test_serve_and_roundtrip_local_fast_path():
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+        await ep.serve_endpoint(_echo_handler)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+        out = [a.data async for a in router.generate({"n": 3, "msg": "hi"})]
+        assert out == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"}, {"i": 2, "msg": "hi"}]
+    finally:
+        await drt.shutdown()
+
+
+async def test_remote_wire_path():
+    """Force the network path by removing the local-engine registry entry:
+    requests go over pub/sub and responses over the TCP call-home plane."""
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+        handle = await ep.serve_endpoint(_echo_handler)
+        drt.local_engines.pop(handle.instance.instance_id)  # simulate remote worker
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+        out = [a.data async for a in router.generate({"n": 4, "msg": "wire"})]
+        assert [o["i"] for o in out] == [0, 1, 2, 3]
+    finally:
+        await drt.shutdown()
+
+
+async def test_round_robin_across_instances():
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+
+        def make_handler(tag):
+            async def handler(request, context):
+                yield {"worker": tag}
+
+            return handler
+
+        await ep.serve_endpoint(make_handler("a"))
+        await ep.serve_endpoint(make_handler("b"))
+        client = await ep.client()
+        await client.wait_for_instances(2, timeout=5)
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        seen = []
+        for _ in range(4):
+            async for a in router.generate({}):
+                seen.append(a.data["worker"])
+        assert sorted(set(seen)) == ["a", "b"]
+        assert seen[:2] != seen[2:4] or seen[0] != seen[1]  # alternates
+    finally:
+        await drt.shutdown()
+
+
+async def test_instance_removed_on_lease_loss():
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+        handle = await ep.serve_endpoint(_echo_handler, lease_ttl_s=0.5)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        # Worker dies: revoke its lease directly (keepalive task can't help).
+        await drt.store.revoke_lease(handle.lease.id)
+        for _ in range(50):
+            if not client.instances:
+                break
+            await asyncio.sleep(0.05)
+        assert not client.instances
+    finally:
+        await drt.shutdown()
+
+
+async def test_cancellation_kills_inflight_request():
+    drt = await make_drt()
+    started = asyncio.Event()
+    progressed = []
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+
+        async def slow_handler(request, context):
+            started.set()
+            for i in range(1000):
+                if context.is_killed():
+                    return
+                progressed.append(i)
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        handle = await ep.serve_endpoint(slow_handler)
+        drt.local_engines.pop(handle.instance.instance_id)  # use wire path
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+
+        ctx = Context()
+        got = []
+        with pytest.raises(RuntimeError):
+            async for a in router.generate({}, ctx):
+                got.append(a.data)
+                if len(got) == 3:
+                    ctx.stop_generating()
+        assert len(progressed) < 1000
+    finally:
+        await drt.shutdown()
+
+
+async def test_stream_disconnect_surfaces_for_migration():
+    """A worker that dies mid-stream must surface StreamDisconnect so the
+    Migration operator can replay (ref: migration.rs)."""
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+
+        async def dying_handler(request, context):
+            yield {"i": 0}
+            raise ConnectionResetError("worker crash")  # simulates abrupt death
+
+        handle = await ep.serve_endpoint(dying_handler)
+        drt.local_engines.pop(handle.instance.instance_id)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+        got = []
+        with pytest.raises(RuntimeError):
+            async for a in router.generate({}):
+                got.append(a.data)
+        assert got == [{"i": 0}]
+    finally:
+        await drt.shutdown()
+
+
+async def test_stats_scrape():
+    drt = await make_drt()
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+        await ep.serve_endpoint(_echo_handler, stats_handler=lambda: {"kv_usage": 0.5})
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        stats = await client.scrape_stats()
+        assert len(stats) == 1
+        (s,) = stats.values()
+        assert s["kv_usage"] == 0.5 and s["in_flight"] == 0
+    finally:
+        await drt.shutdown()

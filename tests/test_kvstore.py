@@ -1,0 +1,95 @@
+"""Unit tests for the KV store control plane (lease + watch semantics the
+whole discovery stack depends on)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.transports.kvstore import (
+    EventType,
+    KeyExists,
+    MemKvStore,
+)
+
+
+async def test_put_get_delete():
+    store = MemKvStore()
+    await store.put("a/b", b"1")
+    entry = await store.get("a/b")
+    assert entry is not None and entry.value == b"1"
+    assert await store.delete("a/b")
+    assert await store.get("a/b") is None
+    assert not await store.delete("a/b")
+    await store.close()
+
+
+async def test_get_prefix_sorted():
+    store = MemKvStore()
+    await store.put("x/2", b"b")
+    await store.put("x/1", b"a")
+    await store.put("y/1", b"c")
+    entries = await store.get_prefix("x/")
+    assert [e.key for e in entries] == ["x/1", "x/2"]
+    await store.close()
+
+
+async def test_create_only():
+    store = MemKvStore()
+    await store.put("k", b"1", create_only=True)
+    with pytest.raises(KeyExists):
+        await store.put("k", b"2", create_only=True)
+    await store.close()
+
+
+async def test_watch_snapshot_then_deltas():
+    store = MemKvStore()
+    await store.put("w/1", b"a")
+    watch = await store.watch_prefix("w/")
+    events = []
+
+    async def consume():
+        async for ev in watch:
+            events.append(ev)
+            if len(events) == 3:
+                return
+
+    task = asyncio.create_task(consume())
+    await asyncio.sleep(0.01)
+    await store.put("w/2", b"b")
+    await store.delete("w/1")
+    await asyncio.wait_for(task, 2)
+    assert (events[0].type, events[0].key) == (EventType.PUT, "w/1")
+    assert (events[1].type, events[1].key) == (EventType.PUT, "w/2")
+    assert (events[2].type, events[2].key) == (EventType.DELETE, "w/1")
+    await watch.cancel()
+    await store.close()
+
+
+async def test_lease_expiry_deletes_keys_and_notifies():
+    store = MemKvStore(reaper_interval_s=0.05)
+    lease = await store.grant_lease(ttl_s=0.15)
+    await store.put("inst/a", b"x", lease_id=lease.id)
+    watch = await store.watch_prefix("inst/")
+    # consume snapshot PUT
+    it = watch._gen()
+    first = await asyncio.wait_for(it.__anext__(), 2)
+    assert first.type == EventType.PUT
+    # no keepalive → reaper deletes the key
+    ev = await asyncio.wait_for(it.__anext__(), 2)
+    assert ev.type == EventType.DELETE and ev.key == "inst/a"
+    assert await store.get("inst/a") is None
+    await watch.cancel()
+    await store.close()
+
+
+async def test_lease_keepalive_preserves_keys():
+    store = MemKvStore(reaper_interval_s=0.05)
+    lease = await store.grant_lease(ttl_s=0.2)
+    await store.put("inst/b", b"x", lease_id=lease.id)
+    for _ in range(5):
+        await asyncio.sleep(0.1)
+        await store.keep_alive(lease.id)
+    assert await store.get("inst/b") is not None
+    await lease.revoke()
+    assert await store.get("inst/b") is None
+    await store.close()

@@ -1,0 +1,95 @@
+"""Unit tests for the pub/sub transport (NATS-role semantics)."""
+
+import asyncio
+
+from dynamo_tpu.runtime.transports.pubsub import MemPubSub, subject_matches
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert subject_matches("a.*.c", "a.b.c")
+    assert subject_matches("a.>", "a.b.c.d")
+    assert not subject_matches("a.b", "a.b.c")
+    assert not subject_matches("a.b.c", "a.b")
+    assert not subject_matches("a.*.x", "a.b.c")
+
+
+async def test_publish_subscribe():
+    bus = MemPubSub()
+    sub = await bus.subscribe("rq.ns.comp.ep.*")
+    await bus.publish("rq.ns.comp.ep.1a", b"hello")
+    msg = await asyncio.wait_for(sub.next(), 2)
+    assert msg.data == b"hello" and msg.subject == "rq.ns.comp.ep.1a"
+    await sub.unsubscribe()
+    await bus.close()
+
+
+async def test_queue_group_load_balance():
+    bus = MemPubSub()
+    s1 = await bus.subscribe("work.q", queue_group="g")
+    s2 = await bus.subscribe("work.q", queue_group="g")
+    for i in range(4):
+        await bus.publish("work.q", str(i).encode())
+    got1 = [await asyncio.wait_for(s1.next(), 2) for _ in range(2)]
+    got2 = [await asyncio.wait_for(s2.next(), 2) for _ in range(2)]
+    all_data = sorted(m.data for m in got1 + got2)
+    assert all_data == [b"0", b"1", b"2", b"3"]
+    await bus.close()
+
+
+async def test_request_reply():
+    bus = MemPubSub()
+    sub = await bus.subscribe("svc.echo")
+
+    async def responder():
+        msg = await sub.next()
+        await bus.publish(msg.reply_to, b"pong:" + msg.data)
+
+    task = asyncio.create_task(responder())
+    reply = await asyncio.wait_for(bus.request("svc.echo", b"ping"), 2)
+    assert reply.data == b"pong:ping"
+    await task
+    await bus.close()
+
+
+async def test_stream_replay_and_tail():
+    bus = MemPubSub()
+    stream = await bus.stream("kv_events")
+    for i in range(3):
+        await stream.publish("kv_events", str(i).encode())
+
+    got = []
+
+    async def consume():
+        async for msg in stream.consume(from_seq=1):
+            got.append(msg)
+            if len(got) == 5:
+                return
+
+    task = asyncio.create_task(consume())
+    await asyncio.sleep(0.01)
+    await stream.publish("kv_events", b"3")
+    await stream.publish("kv_events", b"4")
+    await asyncio.wait_for(task, 2)
+    assert [m.data for m in got] == [b"0", b"1", b"2", b"3", b"4"]
+    assert [m.seq for m in got] == [1, 2, 3, 4, 5]
+
+
+async def test_stream_purge_after_snapshot():
+    bus = MemPubSub()
+    stream = await bus.stream("s")
+    for i in range(10):
+        await stream.publish("s", str(i).encode())
+    await stream.purge(up_to_seq=7)
+    batch = await stream.fetch(from_seq=1)
+    assert [m.seq for m in batch] == [8, 9, 10]
+
+
+async def test_object_store():
+    bus = MemPubSub()
+    store = await bus.object_store("radix-bucket")
+    await store.put("snapshot", b"\x00\x01")
+    assert await store.get("snapshot") == b"\x00\x01"
+    assert await store.list() == ["snapshot"]
+    assert await store.delete("snapshot")
+    assert await store.get("snapshot") is None

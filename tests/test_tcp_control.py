@@ -1,0 +1,179 @@
+"""Tests for the built-in TCP control plane: the same discovery/routing flows
+as test_component.py but across the real broker protocol, including a true
+multi-process worker (the reference's equivalent is tests against live
+etcd+NATS, SURVEY.md §4 item 2)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dynamo_tpu.runtime import DistributedRuntime, PushRouter
+from dynamo_tpu.runtime.runtime import Runtime
+from dynamo_tpu.runtime.transports.tcp_control import (
+    ControlPlaneServer,
+    TcpKvStore,
+    TcpPubSub,
+    connect_control_plane,
+)
+
+
+async def _drt_pair():
+    """Broker + two connected DistributedRuntimes (worker + client)."""
+    server = ControlPlaneServer(host="127.0.0.1", port=0)
+    await server.start()
+    drts = []
+    for _ in range(2):
+        conn = await connect_control_plane(f"127.0.0.1:{server.port}")
+        drt = DistributedRuntime(runtime=Runtime(), store=TcpKvStore(conn), bus=TcpPubSub(conn))
+        await drt.start()
+        drts.append(drt)
+    return server, drts[0], drts[1]
+
+
+async def test_kv_roundtrip_over_tcp():
+    server = ControlPlaneServer(host="127.0.0.1", port=0)
+    await server.start()
+    conn = await connect_control_plane(f"127.0.0.1:{server.port}")
+    store = TcpKvStore(conn)
+    await store.put("a/1", b"x")
+    assert (await store.get("a/1")).value == b"x"
+    assert [e.key for e in await store.get_prefix("a/")] == ["a/1"]
+    snapshot, watch = await store.get_and_watch_prefix("a/")
+    assert [e.key for e in snapshot] == ["a/1"]
+    await store.put("a/2", b"y")
+    ev = await asyncio.wait_for(watch._gen().__anext__(), 2)
+    assert ev.key == "a/2"
+    await watch.cancel()
+    await conn.close()
+    await server.close()
+
+
+async def test_pubsub_and_stream_over_tcp():
+    server = ControlPlaneServer(host="127.0.0.1", port=0)
+    await server.start()
+    conn = await connect_control_plane(f"127.0.0.1:{server.port}")
+    bus = TcpPubSub(conn)
+    sub = await bus.subscribe("x.*")
+    await bus.publish("x.y", b"m1")
+    msg = await asyncio.wait_for(sub.next(), 2)
+    assert msg.data == b"m1"
+
+    stream = await bus.stream("events")
+    await stream.publish("events", b"e1")
+    await stream.publish("events", b"e2")
+    batch = await stream.fetch(1)
+    assert [m.data for m in batch] == [b"e1", b"e2"]
+
+    obj = await bus.object_store("bucket")
+    await obj.put("s", b"blob")
+    assert await obj.get("s") == b"blob"
+    await bus.close()
+    await server.close()
+
+
+async def test_cross_runtime_routing_over_broker():
+    """Two runtimes (worker + frontend) connected only through the broker +
+    TCP call-home data plane."""
+    server, worker_drt, client_drt = await _drt_pair()
+    try:
+        ep_w = worker_drt.namespace("t").component("c").endpoint("gen")
+
+        async def handler(request, context):
+            for i in range(3):
+                yield {"i": i}
+
+        await ep_w.serve_endpoint(handler)
+        ep_c = client_drt.namespace("t").component("c").endpoint("gen")
+        client = await ep_c.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+        out = [a.data["i"] async for a in router.generate({})]
+        assert out == [0, 1, 2]
+    finally:
+        await worker_drt.shutdown()
+        await client_drt.shutdown()
+        await server.close()
+
+
+async def test_worker_death_revokes_leases():
+    """Dropping the worker's broker connection revokes its leases: the
+    client's watch prunes the instance (etcd session-loss semantics)."""
+    server, worker_drt, client_drt = await _drt_pair()
+    try:
+        ep_w = worker_drt.namespace("t").component("c").endpoint("gen")
+
+        async def handler(request, context):
+            yield {}
+
+        await ep_w.serve_endpoint(handler)
+        ep_c = client_drt.namespace("t").component("c").endpoint("gen")
+        client = await ep_c.client()
+        await client.wait_for_instances(1, timeout=5)
+
+        # Simulate worker crash: kill its broker connection abruptly.
+        await worker_drt.store.conn.close()
+        for _ in range(100):
+            if not client.instances:
+                break
+            await asyncio.sleep(0.05)
+        assert not client.instances
+    finally:
+        await client_drt.shutdown()
+        await server.close()
+
+
+@pytest.mark.e2e
+async def test_multiprocess_worker():
+    """Full multi-process slice: broker in-process, worker in a subprocess,
+    requests routed across real sockets."""
+    server = ControlPlaneServer(host="127.0.0.1", port=0)
+    await server.start()
+
+    worker_code = textwrap.dedent(
+        f"""
+        import asyncio, os
+        os.environ["DYN_CONTROL_PLANE"] = "tcp"
+        os.environ["DYN_CONTROL_PLANE_ADDRESS"] = "127.0.0.1:{server.port}"
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        async def handler(request, context):
+            yield {{"echo": request["msg"], "pid": os.getpid()}}
+
+        async def main():
+            drt = await DistributedRuntime.from_settings()
+            ep = drt.namespace("mp").component("c").endpoint("gen")
+            await ep.serve_endpoint(handler)
+            print("READY", flush=True)
+            await asyncio.sleep(60)
+
+        asyncio.run(main())
+        """
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", worker_code], stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True
+    )
+    try:
+        line = await asyncio.wait_for(asyncio.to_thread(proc.stdout.readline), 30)
+        assert "READY" in line
+
+        conn = await connect_control_plane(f"127.0.0.1:{server.port}")
+        drt = DistributedRuntime(runtime=Runtime(), store=TcpKvStore(conn), bus=TcpPubSub(conn))
+        await drt.start()
+        ep = drt.namespace("mp").component("c").endpoint("gen")
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=10)
+        router = PushRouter(client)
+        out = [a.data async for a in router.generate({"msg": "hello"})]
+        assert out[0]["echo"] == "hello"
+        assert out[0]["pid"] == proc.pid
+        await drt.shutdown()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        await server.close()
