@@ -1,0 +1,18 @@
+"""The native TPU engine: JAX/XLA/Pallas model execution with paged KV cache,
+continuous batching, and mesh parallelism.
+
+This is the subsystem the reference *outsources* to vLLM/SGLang/TRT-LLM
+(SURVEY.md §2d engine adapters); dynamo-tpu implements it natively so the
+whole serving stack is TPU-first:
+
+- ``config``     — model architecture configs + presets.
+- ``models``     — functional forward passes (Llama family first).
+- ``kv_cache``   — paged KV cache on device + block allocator.
+- ``attention``  — paged/dense attention (XLA fallback; Pallas kernels).
+- ``sampling``   — jit-compatible token sampling.
+- ``scheduler``  — continuous batching over bucketed compiled steps.
+- ``engine``     — the AsyncEngine facade workers serve.
+- ``sharding``   — jax.sharding meshes + partition specs (TP/EP/...).
+"""
+
+from dynamo_tpu.engine.config import ModelConfig, PRESETS

@@ -1,0 +1,117 @@
+"""Model architecture configuration + presets.
+
+The reference carries per-model config in the ModelDeploymentCard
+(lib/llm/src/model_card.rs:91 — tokenizer, context length, kv block size);
+engine-side architecture lives in the engines themselves. Here both meet:
+:class:`ModelConfig` is the engine-side architecture record the MDC points at.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    # Paged KV cache block size in tokens (ref default: 64 in MDC,
+    # vLLM uses 16; TPU likes multiples of 8 for sublane alignment).
+    block_size: int = 16
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense).
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def replace(self, **kwargs) -> "ModelConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+PRESETS = {
+    # Tiny config for unit tests: fast on a single CPU core.
+    "tiny": ModelConfig(
+        name="tiny",
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        max_seq_len=256,
+        block_size=16,
+        rope_theta=10000.0,
+    ),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        intermediate_size=8192,
+        max_seq_len=131072,
+        tie_word_embeddings=True,
+    ),
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=8192,
+        max_seq_len=131072,
+        tie_word_embeddings=True,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        max_seq_len=8192,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=28672,
+        max_seq_len=8192,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name in PRESETS:
+        return PRESETS[name]
+    raise KeyError(f"unknown model preset: {name} (have {sorted(PRESETS)})")

@@ -1,0 +1,211 @@
+"""TpuEngine: the AsyncEngine facade over the continuous-batching scheduler.
+
+This is what a dynamo-tpu worker serves (the role vLLM's ``AsyncLLM`` plays
+for the reference's vllm adapter, components/backends/vllm handlers.py).
+
+Request wire shape (PreprocessedRequest, ref: protocols/common):
+``{"token_ids": [...], "sampling_options": {...}, "stop_conditions": {...}}``
+Response frames (LLMEngineOutput): ``{"token_ids": [t], "finish_reason": ...,
+"index": 0}`` — detokenization happens upstream in the Backend operator,
+never in the engine.
+
+Single-task ownership: only the engine's step-loop task mutates the
+scheduler; ``generate``/``abort`` stage work through event-loop-local lists,
+and the blocking device step runs via ``asyncio.to_thread`` so serving IO
+never stalls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig, get_config
+from dynamo_tpu.engine.kv_cache import KvEvent
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import (
+    ForwardPassMetrics,
+    Scheduler,
+    SchedulerConfig,
+    Sequence,
+    StepOutput,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class EngineArgs:
+    model: str = "tiny"
+    model_config: Optional[ModelConfig] = None
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    dtype: str = "bfloat16"
+    seed: int = 0
+    eos_token_ids: List[int] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+
+
+class TpuEngine:
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        *,
+        kv_event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.scheduler = scheduler
+        self._staged_adds: List[tuple] = []
+        self._staged_aborts: List[str] = []
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._kv_event_sink = kv_event_sink
+
+    # --- construction -------------------------------------------------------
+    @classmethod
+    def build(
+        cls,
+        args: EngineArgs,
+        *,
+        params=None,
+        kv_event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ) -> "TpuEngine":
+        mc = args.model_config or get_config(args.model)
+        dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+        if params is None:
+            if args.checkpoint_path:
+                from dynamo_tpu.engine.weights import load_checkpoint
+
+                params = load_checkpoint(args.checkpoint_path, mc, dtype=dtype)
+            else:
+                logger.warning("no checkpoint: initializing random weights for %s", mc.name)
+                params = llama.init_params(mc, jax.random.PRNGKey(args.seed), dtype=dtype)
+        engine = cls(
+            Scheduler(
+                mc,
+                params,
+                args.scheduler,
+                dtype=dtype,
+                eos_token_ids=args.eos_token_ids,
+                on_kv_event=lambda ev: engine._on_kv_event(ev),
+                rng_seed=args.seed,
+            ),
+            kv_event_sink=kv_event_sink,
+        )
+        return engine
+
+    def _on_kv_event(self, ev: KvEvent) -> None:
+        if self._kv_event_sink is not None:
+            self._kv_event_sink(ev)
+
+    # --- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(self._loop(), name="engine-step-loop")
+
+    async def stop(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task is not None:
+            await self._loop_task
+            self._loop_task = None
+
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                if not (self._staged_adds or self._staged_aborts or self.scheduler.has_work()):
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                for rid, tokens, sampling, stop, queue in self._staged_adds:
+                    try:
+                        seq = self.scheduler.add_request(rid, tokens, sampling, stop)
+                        seq.out_queue = queue
+                    except ValueError as e:
+                        queue.put_nowait(StepOutput(token_id=-1, finished=True, finish_reason=f"error:{e}"))
+                self._staged_adds.clear()
+                for rid in self._staged_aborts:
+                    self.scheduler.abort(rid)
+                self._staged_aborts.clear()
+
+                outputs = await asyncio.to_thread(self.scheduler.step)
+                for seq, out in outputs:
+                    seq.out_queue.put_nowait(out)
+        except Exception:
+            logger.exception("engine step loop crashed")
+            # Engine death: fail all in-flight requests so streams end and the
+            # migration operator can replay them elsewhere (ref: engine
+            # monitor EngineDeadError flow, vllm handlers.py:88-92).
+            for seq in list(self.scheduler.by_id.values()):
+                seq.out_queue.put_nowait(StepOutput(token_id=-1, finished=True, finish_reason="error:engine_dead"))
+            raise
+
+    # --- AsyncEngine --------------------------------------------------------
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        rid = context.id
+        sampling_d = request.get("sampling_options") or {}
+        temp = sampling_d.get("temperature")
+        sampling = SamplingParams(
+            temperature=1.0 if temp is None else float(temp),  # null ≡ unset ≡ default
+            top_k=int(sampling_d.get("top_k") or 0),
+            top_p=float(sampling_d.get("top_p") or 1.0),
+        )
+        stop = StopConditions.from_dict(request.get("stop_conditions"))
+        queue: "asyncio.Queue[StepOutput]" = asyncio.Queue()
+        self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue))
+        self._wake.set()
+
+        finished = False
+        try:
+            while True:
+                get_task = asyncio.create_task(queue.get())
+                stop_task = asyncio.create_task(context.stopped())
+                done, pending = await asyncio.wait({get_task, stop_task}, return_when=asyncio.FIRST_COMPLETED)
+                for t in pending:
+                    t.cancel()
+                if stop_task in done and get_task not in done:
+                    self.abort(rid)
+                    # Drain until the scheduler confirms cancellation.
+                    out = await queue.get()
+                    while not out.finished:
+                        out = await queue.get()
+                    finished = True
+                    return
+                out = get_task.result()
+                if out.finish_reason and out.finish_reason.startswith("error:"):
+                    finished = True
+                    raise RuntimeError(out.finish_reason[6:])
+                frame = {
+                    "token_ids": [out.token_id] if out.token_id >= 0 else [],
+                    "finish_reason": out.finish_reason,
+                    "index": 0,
+                }
+                yield frame
+                if out.finished:
+                    finished = True
+                    return
+        finally:
+            # Abandoned stream (GeneratorExit / disconnect without kill):
+            # stop decoding a request nobody is reading.
+            if not finished:
+                self.abort(rid)
+
+    def abort(self, request_id: str) -> None:
+        self._staged_aborts.append(request_id)
+        self._wake.set()
+
+    # --- introspection ------------------------------------------------------
+    def metrics(self) -> ForwardPassMetrics:
+        return self.scheduler.metrics()
+
+    def stats_handler(self) -> dict:
+        m = self.scheduler.metrics()
+        return {"kv_usage": m.kv_usage, "num_running": m.num_running, "num_waiting": m.num_waiting}

@@ -1,0 +1,223 @@
+"""Paged KV cache: device arrays + host-side block allocator with prefix
+caching and KV event emission.
+
+The device cache is a global block pool: ``k``/``v`` arrays of shape
+``[layers, num_blocks, block_size, kv_heads, head_dim]``. Sequences own
+*block tables* (lists of block indices); attention gathers through them.
+This is the TPU-native equivalent of vLLM's paged KV plus the engine-side
+part of the reference's KVBM G1 tier (lib/llm/src/block_manager — device
+pool, sequence-hash reuse in block/registry.rs:478, pool/managed.rs
+active/inactive sets with eviction).
+
+Prefix caching: completed full blocks are registered under their chained
+block hash (``dynamo_tpu.llm.tokens``). New sequences match their prefix
+hashes against the registry and skip prefill for matched blocks. Eviction is
+LRU over unreferenced cached blocks. Every register/evict emits a KV event
+for the KV-aware router (ref: kv_router/publisher.rs — the engine→router
+event loop, SURVEY.md §3D).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.llm.tokens import BlockHash
+
+
+@dataclass
+class KvCacheArrays:
+    """Device-side block pool (one array pair covering all layers)."""
+
+    k: jax.Array  # [L, N, BS, KVH, HD]
+    v: jax.Array  # [L, N, BS, KVH, HD]
+
+    @classmethod
+    def create(
+        cls,
+        config: ModelConfig,
+        num_blocks: int,
+        dtype=jnp.bfloat16,
+        sharding: Optional[jax.sharding.Sharding] = None,
+    ) -> "KvCacheArrays":
+        shape = (config.num_layers, num_blocks, config.block_size, config.num_kv_heads, config.head_dim)
+        init = jnp.zeros(shape, dtype=dtype)
+        if sharding is not None:
+            init = jax.device_put(init, sharding)
+        return cls(k=init, v=jnp.copy(init) if sharding is None else jax.device_put(jnp.zeros(shape, dtype=dtype), sharding))
+
+
+class OutOfBlocksError(Exception):
+    pass
+
+
+@dataclass
+class KvEvent:
+    """Engine→router cache event (ref: kv-cache-events consumed by
+    KvIndexer.apply_event, indexer.rs)."""
+
+    kind: str  # "stored" | "removed"
+    block_hashes: List[int]
+    parent_hash: Optional[int] = None
+    ts: float = field(default_factory=time.time)
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": self.kind,
+            "block_hashes": [h & 0xFFFFFFFFFFFFFFFF for h in self.block_hashes],
+            "parent_hash": self.parent_hash,
+            "ts": self.ts,
+        }
+
+
+class BlockAllocator:
+    """Host-side bookkeeping for the device block pool.
+
+    Block states (mirrors pool/managed.rs active/inactive):
+    - free      — on the free list, contents dead.
+    - active    — referenced by ≥1 live sequence (refcount > 0).
+    - cached    — refcount 0 but registered under a block hash; evictable LRU.
+    """
+
+    def __init__(self, num_blocks: int, on_event: Optional[Callable[[KvEvent], None]] = None):
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._refcount: Dict[int, int] = {}
+        # block_hash -> block_id for completed, reusable blocks.
+        self._by_hash: Dict[BlockHash, int] = {}
+        self._hash_of: Dict[int, BlockHash] = {}
+        # LRU over cached (refcount-0, hashed) blocks.
+        self._cached_lru: "OrderedDict[int, None]" = OrderedDict()
+        self.on_event = on_event
+
+    # --- queries ------------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._cached_lru)
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for c in self._refcount.values() if c > 0)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._cached_lru)
+
+    def usage(self) -> float:
+        return 1.0 - len(self._free) / max(self.num_blocks, 1)
+
+    # --- prefix matching ----------------------------------------------------
+    def match_prefix(self, block_hashes: Sequence[BlockHash]) -> List[int]:
+        """Longest prefix of ``block_hashes`` present in cache; acquires a
+        reference on each matched block (caller owns them)."""
+        matched: List[int] = []
+        for h in block_hashes:
+            bid = self._by_hash.get(h)
+            if bid is None:
+                break
+            self._acquire(bid)
+            matched.append(bid)
+        return matched
+
+    # --- allocation ---------------------------------------------------------
+    def allocate(self, n: int) -> List[int]:
+        """Take n fresh blocks, evicting LRU cached blocks as needed."""
+        out: List[int] = []
+        removed_hashes: List[int] = []
+        try:
+            for _ in range(n):
+                if self._free:
+                    bid = self._free.pop()
+                elif self._cached_lru:
+                    bid, _ = self._cached_lru.popitem(last=False)  # LRU evict
+                    h = self._hash_of.pop(bid)
+                    del self._by_hash[h]
+                    removed_hashes.append(h)
+                else:
+                    raise OutOfBlocksError(f"need {n} blocks, {len(out)} available")
+                self._refcount[bid] = 1
+                out.append(bid)
+        except OutOfBlocksError:
+            for bid in out:
+                self.release([bid])
+            raise
+        finally:
+            if removed_hashes and self.on_event:
+                self.on_event(KvEvent(kind="removed", block_hashes=removed_hashes))
+        return out
+
+    def _acquire(self, bid: int) -> None:
+        c = self._refcount.get(bid, 0)
+        if c == 0 and bid in self._cached_lru:
+            del self._cached_lru[bid]
+        self._refcount[bid] = c + 1
+
+    def acquire(self, block_ids: Sequence[int]) -> None:
+        for bid in block_ids:
+            self._acquire(bid)
+
+    def release(self, block_ids: Sequence[int]) -> None:
+        """Drop a reference; refcount-0 blocks become cached (if hashed) or
+        free (if not)."""
+        for bid in block_ids:
+            c = self._refcount.get(bid, 0) - 1
+            if c > 0:
+                self._refcount[bid] = c
+                continue
+            self._refcount.pop(bid, None)
+            if bid in self._hash_of:
+                self._cached_lru[bid] = None
+                self._cached_lru.move_to_end(bid)
+            else:
+                self._free.append(bid)
+
+    # --- hash registration --------------------------------------------------
+    def register_hashes(self, block_ids: Sequence[int], block_hashes: Sequence[BlockHash]) -> None:
+        """Publish completed blocks for reuse (ref: block/registry.rs).
+        Emits a ``stored`` KV event."""
+        stored: List[int] = []
+        event_parent: Optional[int] = None
+        parent: Optional[int] = None  # hash of the previous block in the chain
+        for bid, h in zip(block_ids, block_hashes):
+            if bid in self._hash_of:
+                parent = self._hash_of[bid]
+                continue
+            existing = self._by_hash.get(h)
+            if existing is not None and existing != bid:
+                # Duplicate content: keep the existing registration.
+                parent = h
+                continue
+            self._by_hash[h] = bid
+            self._hash_of[bid] = h
+            if not stored:
+                event_parent = parent  # chain linkage for the router index
+            stored.append(h)
+            parent = h
+        if stored and self.on_event:
+            self.on_event(KvEvent(kind="stored", block_hashes=stored, parent_hash=event_parent))
+
+    def touch(self, block_ids: Sequence[int]) -> None:
+        for bid in block_ids:
+            if bid in self._cached_lru:
+                self._cached_lru.move_to_end(bid)
+
+    def clear_cached(self) -> int:
+        """Drop all refcount-0 cached blocks (ref: clear_kv_blocks endpoint,
+        http/service/clear_kv_blocks.rs). Returns count cleared."""
+        n = len(self._cached_lru)
+        removed = []
+        for bid in list(self._cached_lru):
+            h = self._hash_of.pop(bid)
+            del self._by_hash[h]
+            removed.append(h)
+            self._free.append(bid)
+        self._cached_lru.clear()
+        if removed and self.on_event:
+            self.on_event(KvEvent(kind="removed", block_hashes=removed))
+        return n

@@ -1,0 +1,1 @@
+"""Model forward passes (functional JAX, stacked-layer scan)."""

@@ -1,0 +1,234 @@
+"""Llama-family transformer: functional forward passes over a paged KV cache.
+
+TPU-first design choices (vs the reference's CUDA engines):
+- **Stacked layers + ``lax.scan``**: one compiled layer body regardless of
+  depth — fast compiles, XLA-friendly.
+- **Static shapes**: prefill runs on bucketed sequence lengths, decode on
+  bucketed batch sizes; the scheduler picks the bucket, XLA caches one
+  executable per bucket.
+- **Paged KV**: block-table scatter on write, block gather on read. The
+  gather-based attention keeps everything in pure XLA (works on CPU test
+  meshes); the Pallas paged-attention kernel in
+  ``dynamo_tpu.engine.attention`` replaces the gather on real TPUs.
+- **bf16 weights/activations, f32 softmax + norms** (MXU-friendly).
+
+Block 0 of the pool is reserved as a scratch sink: padded token positions
+scatter there so no real block is corrupted (the allocator never hands out
+block 0).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.engine.config import ModelConfig
+
+Params = Dict[str, jax.Array]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init weights (testing / benchmarking). HF checkpoint loading
+    lives in ``dynamo_tpu.engine.weights``."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5 if len(shape) >= 2 else 0.02)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    L = c.num_layers
+    keys = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": dense(k_embed, (c.vocab_size, c.hidden_size), scale=0.02),
+        "final_norm": jnp.ones((c.hidden_size,), dtype=dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+            "mlp_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+            "wq": dense(keys[0], (L, c.hidden_size, c.q_size)),
+            "wk": dense(keys[1], (L, c.hidden_size, c.kv_size)),
+            "wv": dense(keys[2], (L, c.hidden_size, c.kv_size)),
+            "wo": dense(keys[3], (L, c.q_size, c.hidden_size)),
+            "w_gate": dense(keys[4], (L, c.hidden_size, c.intermediate_size)),
+            "w_up": dense(keys[5], (L, c.hidden_size, c.intermediate_size)),
+            "w_down": dense(keys[6], (L, c.intermediate_size, c.hidden_size)),
+        },
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = dense(k_head, (c.hidden_size, c.vocab_size), scale=0.02)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    norm = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [..., T, heads, head_dim]; positions: [..., T]."""
+    freqs = rope_frequencies(x.shape[-1], theta)  # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, config: ModelConfig) -> jax.Array:
+    """q: [T, H, hd]; k/v: [S, KVH, hd]; mask: [T, S] bool → [T, H, hd]."""
+    groups = config.num_heads // config.num_kv_heads
+    k = jnp.repeat(k, groups, axis=1)  # [S, H, hd]
+    v = jnp.repeat(v, groups, axis=1)
+    scale = config.head_dim ** -0.5
+    scores = jnp.einsum("thd,shd->hts", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(mask[None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("hts,shd->thd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+
+def prefill(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [T] bucket-padded token ids
+    valid_len: jax.Array,  # scalar: actual new tokens
+    cache_len: jax.Array,  # scalar: tokens already in the block table (prefix reuse / chunked prefill)
+    block_table: jax.Array,  # [max_blocks] block ids (0 = scratch)
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One prefill (or prefill chunk). Returns (last_logits [V], k_cache, v_cache)."""
+    c = config
+    bs = c.block_size
+    T = tokens.shape[0]
+    ctx = block_table.shape[0] * bs
+
+    h = params["embed"].at[tokens].get(mode="clip")  # [T, D]
+    positions = cache_len + jnp.arange(T, dtype=jnp.int32)
+    valid_q = jnp.arange(T, dtype=jnp.int32) < valid_len
+
+    # Scatter targets for the new tokens; padded positions sink to block 0.
+    slots = jnp.where(valid_q, positions, 0)
+    tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)  # [T]
+    tgt_offs = slots % bs
+
+    # Context mask: key j attends iff j is written (< cache_len+valid) and
+    # causal wrt query position. Computed once, reused every layer.
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    total = cache_len + valid_len
+    mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)  # [T, ctx]
+
+    def layer_fn(h, xs):
+        lp, kc, vc = xs  # kc: [N, BS, KVH, HD]
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        kc = kc.at[tgt_blocks, tgt_offs].set(k)
+        vc = vc.at[tgt_blocks, tgt_offs].set(v)
+
+        k_ctx = kc[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
+        v_ctx = vc[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
+        attn = _attend(q, k_ctx, v_ctx, mask, c)
+        h = h + attn.reshape(T, c.q_size) @ lp["wo"]
+
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
+
+    last = jnp.maximum(valid_len - 1, 0)
+    h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = h_last @ (head if head is not None else params["embed"].T)
+    return logits.astype(jnp.float32), k_new, v_new
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+
+def decode(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B] current token per sequence
+    positions: jax.Array,  # [B] position of each token (its write slot)
+    block_tables: jax.Array,  # [B, max_blocks]
+    active: jax.Array,  # [B] bool — padded batch slots are False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for a batch. Returns (logits [B, V], k_cache, v_cache)."""
+    c = config
+    bs = c.block_size
+    B = tokens.shape[0]
+    ctx = block_tables.shape[1] * bs
+
+    h = params["embed"].at[tokens].get(mode="clip")  # [B, D]
+
+    slots = jnp.where(active, positions, 0)
+    tgt_blocks = jnp.where(active, jnp.take_along_axis(block_tables, (slots // bs)[:, None], axis=1)[:, 0], 0)
+    tgt_offs = slots % bs
+
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    mask = key_pos[None, :] <= positions[:, None]  # [B, ctx]
+
+    def layer_fn(h, xs):
+        lp, kc, vc = xs
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions[:, None], c.rope_theta)[:, 0]  # [B, H, hd]
+        k = apply_rope(k, positions[:, None], c.rope_theta)[:, 0]
+        v = v[:, 0]
+
+        kc = kc.at[tgt_blocks, tgt_offs].set(k)
+        vc = vc.at[tgt_blocks, tgt_offs].set(v)
+
+        k_ctx = kc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+        v_ctx = vc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+
+        attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
+            q, k_ctx, v_ctx, mask
+        )  # [B, H, hd]
+        h = h + attn.reshape(B, c.q_size) @ lp["wo"]
+
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
+
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = h @ (head if head is not None else params["embed"].T)
+    return logits.astype(jnp.float32), k_new, v_new

@@ -1,0 +1,68 @@
+"""Jit-compatible token sampling: greedy / temperature / top-k / top-p.
+
+Sampling parameters arrive per-request (ref: protocols/common SamplingOptions,
+SURVEY.md §2b protocols); the scheduler batches them into per-slot arrays so
+one compiled sampler serves mixed-parameter batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class SamplingParams:
+    """Host-side per-request sampling options."""
+
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def sample_batch(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,  # [B] f32 (0 = greedy)
+    top_k: jax.Array,  # [B] i32 (0 = off)
+    top_p: jax.Array,  # [B] f32 (1 = off)
+    key: jax.Array,
+) -> jax.Array:
+    """Sample one token per row honouring per-row parameters. Greedy rows
+    (temperature 0) take argmax."""
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+
+    # top-k: mask everything below the k-th largest (k=0 disables).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus): keep the smallest set of tokens with cumulative
+    # probability >= top_p. Always keep the argmax.
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_mask_sorted = (cum - probs_sorted) < top_p[:, None]  # keep while prior mass < p
+    # Map the sorted-space threshold back: keep token if its prob >= min kept prob.
+    min_kept = jnp.min(jnp.where(cutoff_mask_sorted, sorted_desc, jnp.inf), axis=-1)
+    scaled = jnp.where(scaled >= min_kept[:, None], scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy_tok).astype(jnp.int32)
+
+
+def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-probability of chosen tokens. logits [B, V], tokens [B] → [B]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]

@@ -1,0 +1,459 @@
+"""Continuous batching scheduler: the engine's step loop.
+
+The reference outsources this to vLLM/SGLang/TRT-LLM schedulers; the mocker
+(lib/llm/src/mocker/scheduler.rs:240) emulates exactly this machinery —
+prefill admission, decode batching, KV block accounting, eviction. Here it is
+implemented for real against XLA's static-shape world:
+
+- **Bucketed compilation**: prefill lengths and decode batch sizes round up
+  to power-of-two buckets; XLA compiles one executable per bucket and reuses
+  it (SURVEY.md §7 hard part (b)).
+- **Chunked prefill**: prompts longer than the largest bucket run as chunks,
+  interleaving with decode so long prompts don't starve running sequences.
+- **Prefix caching**: prompt block hashes are matched against the allocator's
+  registry; matched blocks skip prefill entirely (the engine-side half of the
+  KV-aware routing story, §3D).
+- **Priority**: decode-first each iteration (keeps ITL low), one prefill
+  admission per iteration (bounds TTFT).
+
+The step loop runs in a worker thread (`asyncio.to_thread`) so device-blocked
+steps never stall the process's asyncio IO (the serving plane).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays, KvEvent, OutOfBlocksError
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams, sample_batch
+from dynamo_tpu.llm.tokens import extend_block_hashes
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def next_bucket(n: int, buckets: List[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class StopConditions:
+    max_tokens: int = 256
+    min_tokens: int = 0
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "StopConditions":
+        d = d or {}
+        return cls(
+            max_tokens=d.get("max_tokens") or 256,
+            min_tokens=d.get("min_tokens") or 0,
+            stop_token_ids=list(d.get("stop_token_ids") or []),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+        )
+
+
+class SeqState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"  # mid chunked-prefill
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class StepOutput:
+    token_id: int
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    logprob: Optional[float] = None
+
+
+@dataclass
+class Sequence:
+    request_id: str
+    prompt: List[int]
+    sampling: SamplingParams
+    stop: StopConditions
+    eos_token_ids: List[int] = field(default_factory=list)
+    # runtime state
+    state: SeqState = SeqState.WAITING
+    output_ids: List[int] = field(default_factory=list)
+    block_ids: List[int] = field(default_factory=list)
+    num_computed: int = 0  # prompt tokens whose KV is in cache
+    block_hashes: List[int] = field(default_factory=list)
+    num_cached_blocks: int = 0  # prefix blocks reused from cache
+    out_queue: "asyncio.Queue[Optional[StepOutput]]" = field(default_factory=asyncio.Queue)
+    arrival_ts: float = field(default_factory=time.monotonic)
+    first_token_ts: Optional[float] = None
+    aborted: bool = False
+    abort_reason: str = "cancelled"
+
+    @property
+    def all_ids(self) -> List[int]:
+        return self.prompt + self.output_ids
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output_ids)
+
+
+@dataclass
+class SchedulerConfig:
+    num_blocks: int = 512
+    max_running: int = 16  # decode slots
+    prefill_buckets: List[int] = field(default_factory=lambda: [32, 64, 128, 256, 512, 1024, 2048])
+    decode_buckets: List[int] = field(default_factory=lambda: [1, 2, 4, 8, 16])
+    max_prefill_chunk: int = 2048
+    enable_prefix_caching: bool = True
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load snapshot published to the router
+    (ref: _core.pyi:354-427 ForwardPassMetrics{WorkerStats, KvStats})."""
+
+    num_running: int = 0
+    num_waiting: int = 0
+    kv_usage: float = 0.0
+    kv_total_blocks: int = 0
+    kv_active_blocks: int = 0
+    prefill_tokens_in_flight: int = 0
+    request_total: int = 0
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+
+class Scheduler:
+    """Owns the device cache + compiled steps + the running/waiting sets.
+
+    Synchronous core (stepped from a thread by TpuEngine); asyncio-facing
+    methods only touch queues/events.
+    """
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        params,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        *,
+        dtype=jnp.bfloat16,
+        on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+        eos_token_ids: Optional[List[int]] = None,
+        rng_seed: int = 0,
+    ):
+        self.mc = model_config
+        self.sc = scheduler_config or SchedulerConfig()
+        self.params = params
+        self.allocator = BlockAllocator(self.sc.num_blocks, on_event=on_kv_event)
+        # Reserve block 0 as the scratch sink for padded scatter positions.
+        self.allocator._free.remove(0)
+        self.cache = KvCacheArrays.create(model_config, self.sc.num_blocks, dtype=dtype)
+        self.max_blocks_per_seq = (model_config.max_seq_len + model_config.block_size - 1) // model_config.block_size
+
+        self.waiting: List[Sequence] = []
+        self.running: List[Sequence] = []
+        self.by_id: Dict[str, Sequence] = {}
+        self.request_total = 0
+        self._eos = eos_token_ids or []
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._step_counter = 0
+
+        # Trim buckets to the model's max length.
+        self.sc.prefill_buckets = [b for b in self.sc.prefill_buckets if b <= model_config.max_seq_len] or [
+            model_config.max_seq_len
+        ]
+
+        self._prefill_jit = jax.jit(
+            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, self.mc, k, v, t, vl, cl, bt),
+            donate_argnums=(1, 2),
+        )
+        self._decode_jit = jax.jit(
+            lambda p, k, v, t, pos, bt, act: llama.decode(p, self.mc, k, v, t, pos, bt, act),
+            donate_argnums=(1, 2),
+        )
+        self._sample_jit = jax.jit(sample_batch)
+
+    # --- public API (called from event loop) --------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        token_ids: List[int],
+        sampling: SamplingParams,
+        stop: StopConditions,
+    ) -> Sequence:
+        if not token_ids:
+            raise ValueError("empty prompt")
+        if len(token_ids) >= self.mc.max_seq_len:
+            raise ValueError(f"prompt length {len(token_ids)} >= max_seq_len {self.mc.max_seq_len}")
+        seq = Sequence(
+            request_id=request_id,
+            prompt=list(token_ids),
+            sampling=sampling,
+            stop=stop,
+            eos_token_ids=self._eos,
+        )
+        self.waiting.append(seq)
+        self.by_id[request_id] = seq
+        self.request_total += 1
+        return seq
+
+    def abort(self, request_id: str) -> None:
+        seq = self.by_id.get(request_id)
+        if seq is not None and seq.state != SeqState.FINISHED:
+            seq.aborted = True
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def metrics(self) -> ForwardPassMetrics:
+        a = self.allocator
+        return ForwardPassMetrics(
+            num_running=len(self.running),
+            num_waiting=len(self.waiting),
+            kv_usage=a.usage(),
+            kv_total_blocks=a.num_blocks,
+            kv_active_blocks=a.num_active,
+            prefill_tokens_in_flight=sum(len(s.prompt) - s.num_computed for s in self.waiting),
+            request_total=self.request_total,
+        )
+
+    # --- step loop core (runs in worker thread) -----------------------------
+    def step(self) -> List[tuple]:
+        """One scheduler iteration. Returns [(seq, StepOutput), ...]."""
+        outputs: List[tuple] = []
+        self._reap_aborted(outputs)
+        # Decode first (ITL), then admit one prefill (TTFT).
+        if self.running:
+            outputs.extend(self._decode_step())
+        self._admit(outputs)
+        return outputs
+
+    def _reap_aborted(self, outputs: List[tuple]) -> None:
+        for seq in list(self.running):
+            if seq.aborted:
+                self._finish(seq, seq.abort_reason, outputs)
+        for seq in list(self.waiting):
+            if seq.aborted:
+                self.waiting.remove(seq)
+                seq.state = SeqState.FINISHED
+                # Mid-prefill cancellations already hold blocks — release them.
+                self.allocator.release(seq.block_ids)
+                seq.block_ids = []
+                self.by_id.pop(seq.request_id, None)
+                outputs.append((seq, StepOutput(token_id=-1, finished=True, finish_reason=seq.abort_reason)))
+
+    def _admit(self, outputs: List[tuple]) -> None:
+        """Admit at most one waiting sequence per iteration (chunked)."""
+        if not self.waiting or len(self.running) >= self.sc.max_running:
+            return
+        seq = self.waiting[0]
+        try:
+            done = self._prefill_one(seq, outputs)
+        except OutOfBlocksError:
+            # Not enough KV blocks — leave in queue; decode progress will
+            # free/evict blocks. (The reference's engines preempt here; we
+            # backpressure instead.)
+            return
+        if done:
+            self.waiting.pop(0)
+
+    def _prefill_one(self, seq: Sequence, outputs: List[tuple]) -> bool:
+        """Run one prefill chunk for ``seq``. Returns True when the prompt is
+        fully computed (sequence moved to running)."""
+        bs = self.mc.block_size
+        if seq.state == SeqState.WAITING:
+            # First touch: prefix-cache match + full block allocation. Must be
+            # all-or-nothing: a partial failure here re-runs next step, so any
+            # acquired refs/blocks must be returned before backing off.
+            try:
+                if self.sc.enable_prefix_caching:
+                    seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
+                    matched = self.allocator.match_prefix(seq.block_hashes)
+                    # Keep at least one token to prefill so we always produce logits.
+                    if matched and len(matched) * bs >= len(seq.prompt):
+                        self.allocator.release([matched[-1]])
+                        matched = matched[:-1]
+                    seq.block_ids = list(matched)
+                    seq.num_cached_blocks = len(matched)
+                    seq.num_computed = len(matched) * bs
+                needed = (len(seq.prompt) + 1 + bs - 1) // bs - len(seq.block_ids)  # +1 for first decode token
+                if needed > 0:
+                    seq.block_ids.extend(self.allocator.allocate(needed))
+            except OutOfBlocksError:
+                self.allocator.release(seq.block_ids)
+                seq.block_ids = []
+                seq.num_cached_blocks = 0
+                seq.num_computed = 0
+                raise
+            seq.state = SeqState.PREFILL
+
+        remaining = len(seq.prompt) - seq.num_computed
+        chunk = min(remaining, self.sc.max_prefill_chunk)
+        bucket = next_bucket(chunk, self.sc.prefill_buckets)
+        chunk = min(chunk, bucket)
+
+        tokens = seq.prompt[seq.num_computed : seq.num_computed + chunk]
+        padded = np.zeros((bucket,), dtype=np.int32)
+        padded[: len(tokens)] = tokens
+        table = self._block_table(seq)
+
+        logits, self.cache.k, self.cache.v = self._prefill_jit(
+            self.params,
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(padded),
+            jnp.int32(len(tokens)),
+            jnp.int32(seq.num_computed),
+            table,
+        )
+        seq.num_computed += len(tokens)
+
+        if seq.num_computed < len(seq.prompt):
+            return False  # more chunks to go
+
+        # Prompt fully computed: sample the first token.
+        token = self._sample_one(seq, logits)
+        seq.first_token_ts = time.monotonic()
+        seq.state = SeqState.RUNNING
+        self.running.append(seq)
+        self._register_full_blocks(seq)
+        self._append_token(seq, token, outputs)
+        return True
+
+    def _decode_step(self) -> List[tuple]:
+        outputs: List[tuple] = []
+        n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
+        batch = self.running[:n]
+        bucket = next_bucket(n, self.sc.decode_buckets)
+
+        tokens = np.zeros((bucket,), dtype=np.int32)
+        positions = np.zeros((bucket,), dtype=np.int32)
+        tables = np.zeros((bucket, self.max_blocks_per_seq), dtype=np.int32)
+        active = np.zeros((bucket,), dtype=bool)
+        temps = np.ones((bucket,), dtype=np.float32)
+        top_ks = np.zeros((bucket,), dtype=np.int32)
+        top_ps = np.ones((bucket,), dtype=np.float32)
+
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.all_ids[-1]
+            positions[i] = seq.total_len - 1  # write slot of the current token
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            active[i] = True
+            temps[i] = seq.sampling.temperature
+            top_ks[i] = seq.sampling.top_k
+            top_ps[i] = seq.sampling.top_p
+
+        logits, self.cache.k, self.cache.v = self._decode_jit(
+            self.params,
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(active),
+        )
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng, self._step_counter)
+        sampled = np.asarray(
+            self._sample_jit(logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key)
+        )
+
+        for i, seq in enumerate(batch):
+            self._ensure_block_capacity(seq)
+            self._append_token(seq, int(sampled[i]), outputs)
+        return outputs
+
+    # --- helpers ------------------------------------------------------------
+    def _block_table(self, seq: Sequence) -> jnp.ndarray:
+        table = np.zeros((self.max_blocks_per_seq,), dtype=np.int32)
+        table[: len(seq.block_ids)] = seq.block_ids
+        return jnp.asarray(table)
+
+    def _ensure_block_capacity(self, seq: Sequence) -> None:
+        """Grow the block table if the *next* token would overflow it."""
+        bs = self.mc.block_size
+        if seq.total_len + 1 > len(seq.block_ids) * bs:
+            try:
+                seq.block_ids.extend(self.allocator.allocate(1))
+            except OutOfBlocksError:
+                # Out of memory mid-decode: finish the sequence with "length".
+                seq.aborted = True
+                seq.abort_reason = "length"
+                logger.warning("seq %s out of KV blocks at len %d", seq.request_id, seq.total_len)
+
+    def _sample_one(self, seq: Sequence, logits: jax.Array) -> int:
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng, self._step_counter)
+        s = seq.sampling
+        tok = self._sample_jit(
+            logits[None, :],
+            jnp.asarray([s.temperature], dtype=jnp.float32),
+            jnp.asarray([s.top_k], dtype=jnp.int32),
+            jnp.asarray([s.top_p], dtype=jnp.float32),
+            key,
+        )
+        return int(np.asarray(tok)[0])
+
+    def _append_token(self, seq: Sequence, token: int, outputs: List[tuple]) -> None:
+        seq.output_ids.append(token)
+        reason = self._check_stop(seq, token)
+        if reason is not None:
+            # Token that triggered 'stop' is still emitted (backend strips).
+            outputs.append((seq, StepOutput(token_id=token, finished=True, finish_reason=reason)))
+            self._finish(seq, reason, outputs, emit=False)
+        else:
+            outputs.append((seq, StepOutput(token_id=token)))
+
+    def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:
+        n_out = len(seq.output_ids)
+        if n_out >= seq.stop.min_tokens:
+            if not seq.stop.ignore_eos and token in seq.eos_token_ids:
+                return "stop"
+            if token in seq.stop.stop_token_ids:
+                return "stop"
+        if n_out >= seq.stop.max_tokens:
+            return "length"
+        if seq.total_len >= self.mc.max_seq_len:
+            return "length"
+        return None
+
+    def _register_full_blocks(self, seq: Sequence) -> None:
+        """Publish completed prompt blocks for prefix reuse."""
+        if not self.sc.enable_prefix_caching:
+            return
+        bs = self.mc.block_size
+        n_full = len(seq.prompt) // bs
+        if n_full > seq.num_cached_blocks:
+            self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
+
+    def _finish(self, seq: Sequence, reason: str, outputs: List[tuple], emit: bool = True) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        seq.state = SeqState.FINISHED
+        # Extend hashes over generated tokens so completed output blocks are
+        # reusable too (multi-turn: next request's prompt includes them).
+        if self.sc.enable_prefix_caching and reason != "cancelled":
+            bs = self.mc.block_size
+            seq.block_hashes = extend_block_hashes(seq.block_hashes, seq.all_ids, bs)
+            n_full = len(seq.all_ids) // bs
+            self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
+        self.allocator.release(seq.block_ids)
+        seq.block_ids = []
+        if emit:
+            outputs.append((seq, StepOutput(token_id=-1, finished=True, finish_reason=reason)))
+        self.by_id.pop(seq.request_id, None)

@@ -1,0 +1,100 @@
+"""Device meshes + partition specs for the engine.
+
+The reference delegates TP/PP/EP to engine-internal NCCL (SURVEY.md §2e);
+here parallelism is native: a ``jax.sharding.Mesh`` with named axes and
+GSPMD-propagated shardings. XLA inserts the collectives (all-reduce after
+row-parallel matmuls, etc.) over ICI — no hand-written comm code in the
+model.
+
+Axis convention:
+- ``dp``   — data parallel (batch) across chips within one engine instance.
+- ``tp``   — tensor parallel: attention heads + MLP hidden dim.
+- ``ep``   — expert parallel (MoE models).
+- ``sp``   — sequence/context parallel (ring attention, long prefill).
+
+Weight layout (megatron-style column→row pairs so each layer needs exactly
+one all-reduce per block):
+- wq/wk/wv, w_gate/w_up: shard output dim over tp (column-parallel).
+- wo, w_down:            shard input dim over tp (row-parallel).
+- KV cache:              shard kv_heads over tp.
+- embed/lm_head:         shard vocab over tp.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    tp: int = 1
+    dp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.tp * self.dp * self.ep * self.sp
+
+
+def build_mesh(parallel: ParallelConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = parallel.total
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for {parallel}, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(parallel.dp, parallel.sp, parallel.ep, parallel.tp)
+    return Mesh(arr, axis_names=("dp", "sp", "ep", "tp"))
+
+
+def param_specs(tie_word_embeddings: bool) -> dict:
+    """PartitionSpec pytree matching llama.init_params structure."""
+    specs = {
+        "embed": P("tp", None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "mlp_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+    }
+    if not tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def kv_cache_spec(num_kv_heads: int = 0, tp_size: int = 1) -> P:
+    """[L, N, BS, KVH, HD] — shard kv heads over tp when divisible; when
+    tp > kv_heads (e.g. 70B kv_heads=8 on tp=16) the cache replicates and the
+    duplicated-KV-head handling lives in the attention partitioning."""
+    if tp_size > 1 and num_kv_heads % tp_size == 0:
+        return P(None, None, None, "tp", None)
+    return P(None, None, None, None, None)
+
+
+def shard_params(params, mesh: Mesh, tie_word_embeddings: bool):
+    specs = param_specs(tie_word_embeddings)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, jax.Array),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp"))

@@ -1,0 +1,99 @@
+"""Checkpoint loading: HF safetensors → stacked-layer JAX params.
+
+The reference resolves/downloads models via hf-hub (lib/llm/src/local_model.rs
+hub.rs:299); in this zero-egress environment we load from a local directory
+only. Conversion maps per-layer HF tensors onto the stacked ``[L, ...]``
+layout ``dynamo_tpu.engine.models.llama`` scans over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelConfig
+
+
+def config_from_hf(path: str) -> ModelConfig:
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    return ModelConfig(
+        name=os.path.basename(path.rstrip("/")),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_size=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 500000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def load_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """Load HF Llama safetensors from a local directory into stacked params."""
+    from safetensors import safe_open
+
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {path}")
+
+    raw: Dict[str, np.ndarray] = {}
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                raw[key] = f.get_tensor(key)
+
+    c = config
+    L = c.num_layers
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        # HF nn.Linear stores [out, in]; our layout is [in, out].
+        layers = [raw[fmt.format(l)] for l in range(L)]
+        arr = np.stack(layers)
+        if transpose:
+            arr = arr.transpose(0, 2, 1)
+        return jnp.asarray(arr, dtype=dtype)
+
+    params = {
+        "embed": jnp.asarray(raw["model.embed_tokens.weight"], dtype=dtype),
+        "final_norm": jnp.asarray(raw["model.norm.weight"], dtype=dtype),
+        "layers": {
+            "attn_norm": jnp.asarray(
+                np.stack([raw[f"model.layers.{l}.input_layernorm.weight"] for l in range(L)]), dtype=dtype
+            ),
+            "mlp_norm": jnp.asarray(
+                np.stack([raw[f"model.layers.{l}.post_attention_layernorm.weight"] for l in range(L)]), dtype=dtype
+            ),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+    if not c.tie_word_embeddings and "lm_head.weight" in raw:
+        params["lm_head"] = jnp.asarray(raw["lm_head.weight"].T, dtype=dtype)
+    return params
+
+
+def resolve_model(name_or_path: str) -> Optional[str]:
+    """Return a local checkpoint dir if one exists (no network egress)."""
+    candidates = [
+        name_or_path,
+        os.path.expanduser(f"~/.cache/huggingface/hub/models--{name_or_path.replace('/', '--')}"),
+    ]
+    for c in candidates:
+        if os.path.isdir(c) and any(f.endswith(".safetensors") for f in os.listdir(c)):
+            return c
+    return None

@@ -1,0 +1,2 @@
+"""LLM serving library: protocols, preprocessing, routing, KV block
+management, disaggregation (ref: lib/llm — SURVEY.md §2b)."""

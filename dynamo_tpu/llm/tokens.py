@@ -1,0 +1,90 @@
+"""Token sequences, block partitioning, and chained block hashing.
+
+Ref: lib/tokens/src/lib.rs (611 LoC) and lib/llm/src/tokens.rs —
+``compute_hash_v2`` = xxh3_64_with_seed (tokens.rs:36), ``SequenceHash``
+(:33). Block hashes chain: each block's hash seeds from its parent's, so a
+block hash identifies the *entire prefix* ending at that block. Router
+overlap matching and engine prefix caching both key on these.
+
+Python fallback uses the ``xxhash`` wheel; the C++ native extension
+(``native/tokenhash``) replaces the hot loop when built.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import xxhash
+
+# Seed for the first block in a sequence (no parent). The reference uses its
+# own constant; any fixed seed works as long as engine + router agree.
+ROOT_SEED = 0x6462_6C6B  # "dblk"
+
+try:  # optional native hot path (built by native/setup.py)
+    from dynamo_tpu_native import hash_token_blocks as _native_hash_blocks  # type: ignore
+except Exception:  # pragma: no cover - native ext optional
+    _native_hash_blocks = None
+
+BlockHash = int
+SequenceHash = int
+
+
+def hash_tokens(tokens: Sequence[int], seed: int = ROOT_SEED) -> int:
+    """xxh3_64 over little-endian u32 token ids, seeded (ref: tokens.rs:36)."""
+    buf = struct.pack(f"<{len(tokens)}I", *tokens)
+    return xxhash.xxh3_64_intdigest(buf, seed=seed)
+
+
+def compute_block_hashes(tokens: Sequence[int], block_size: int) -> List[BlockHash]:
+    """Chained hashes for each *complete* block of the token sequence.
+
+    block_hash[i] = xxh3(tokens[i*bs:(i+1)*bs], seed=block_hash[i-1])
+    Partial trailing blocks get no hash (they are not reusable).
+    """
+    n_full = len(tokens) // block_size
+    if _native_hash_blocks is not None:
+        return _native_hash_blocks(list(tokens), block_size, ROOT_SEED)
+    hashes: List[BlockHash] = []
+    seed = ROOT_SEED
+    for i in range(n_full):
+        h = hash_tokens(tokens[i * block_size : (i + 1) * block_size], seed)
+        hashes.append(h)
+        seed = h
+    return hashes
+
+
+def extend_block_hashes(
+    prev_hashes: List[BlockHash], tokens: Sequence[int], block_size: int
+) -> List[BlockHash]:
+    """Incrementally extend: hash only blocks beyond len(prev_hashes)."""
+    n_full = len(tokens) // block_size
+    hashes = list(prev_hashes)
+    seed = hashes[-1] if hashes else ROOT_SEED
+    for i in range(len(hashes), n_full):
+        h = hash_tokens(tokens[i * block_size : (i + 1) * block_size], seed)
+        hashes.append(h)
+        seed = h
+    return hashes
+
+
+@dataclass
+class TokenBlock:
+    """A fixed-size block of tokens with its chained hash."""
+
+    tokens: List[int]
+    block_hash: BlockHash
+    parent_hash: Optional[BlockHash]
+
+
+def to_blocks(tokens: Sequence[int], block_size: int) -> List[TokenBlock]:
+    hashes = compute_block_hashes(tokens, block_size)
+    blocks = []
+    parent: Optional[BlockHash] = None
+    for i, h in enumerate(hashes):
+        blocks.append(
+            TokenBlock(tokens=list(tokens[i * block_size : (i + 1) * block_size]), block_hash=h, parent_hash=parent)
+        )
+        parent = h
+    return blocks

@@ -7,11 +7,21 @@ Must set env before jax initializes a backend.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the session env points at a real TPU (axon): tests must
+# be hermetic and the single real chip is reserved for benchmarking. The env
+# var alone is NOT enough — the axon PJRT plugin overrides JAX_PLATFORMS, so
+# we also set the config flag right after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DYN_LOG", "WARNING")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 import asyncio
 import functools
