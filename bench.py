@@ -1,0 +1,88 @@
+"""Benchmark: decode throughput of the JAX engine on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): the reference's profiling example reports
+decode ITL 4.83 ms ⇒ 51.22 tok/s/GPU *per user* for DS-Distill-Llama-8B at
+TP4 on H100. Per-chip decode throughput here = batch tokens per step /
+step time on one TPU v5e chip (llama-3.2-1b unless overridden). The
+comparison is loose (different model/HW class) — it anchors the per-user
+decode rate scale until multi-chip 8B/70B configs run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.kv_cache import KvCacheArrays
+    from dynamo_tpu.engine.models import llama
+
+    model = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "64"))
+    ctx_len = int(os.environ.get("BENCH_CTX", "1024"))
+
+    cfg = get_config(model).replace(max_seq_len=max(2048, ctx_len + 128))
+    num_blocks = batch * (ctx_len // cfg.block_size + 4) + 8
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
+
+    max_blocks = cfg.max_seq_len // cfg.block_size
+    tables = jnp.tile(jnp.arange(1, max_blocks + 1, dtype=jnp.int32)[None, :], (batch, 1))
+    # Distinct blocks per sequence (wrap within pool to stay allocated).
+    tables = (tables + jnp.arange(batch, dtype=jnp.int32)[:, None] * (ctx_len // cfg.block_size)) % (num_blocks - 1) + 1
+    active = jnp.ones((batch,), dtype=bool)
+
+    decode = jax.jit(
+        lambda p, k, v, t, pos: llama.decode(p, cfg, k, v, t, pos, tables, active),
+        donate_argnums=(1, 2),
+    )
+
+    toks = jnp.zeros((batch,), dtype=jnp.int32)
+    pos = jnp.full((batch,), ctx_len, dtype=jnp.int32)
+    k, v = cache.k, cache.v
+
+    # Warmup / compile.
+    logits, k, v = decode(params, k, v, toks, pos)
+    logits.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        logits, k, v = decode(params, k, v, toks, pos + i)
+    logits.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1000
+    tok_s_per_user = 1.0 / (dt / steps)  # one token per user per step
+    tok_s_chip = batch * steps / dt
+
+    baseline_tok_s_user = 51.22  # H100 TP4 8B decode (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tok_s_per_user_{model}_b{batch}_ctx{ctx_len}",
+                "value": round(tok_s_per_user, 2),
+                "unit": "tok/s/user",
+                "vs_baseline": round(tok_s_per_user / baseline_tok_s_user, 3),
+                "detail": {
+                    "step_ms": round(step_ms, 3),
+                    "tok_s_per_chip": round(tok_s_chip, 1),
+                    "batch": batch,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
