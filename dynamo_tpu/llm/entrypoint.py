@@ -1,0 +1,141 @@
+"""Entrypoints: pipeline assembly + worker registration + serve modes.
+
+Ref: lib/llm/src/entrypoint/* — ``EngineConfig`` variants (entrypoint.rs:42),
+``run_input`` (input.rs:109), pipeline builders (input/common.rs:194
+``build_pipeline``, :226 ``build_routed_pipeline``: frontend → preprocessor →
+backend → migration → router → engine), worker-side ``input/endpoint.rs``
+(serve a ``dyn://ns.comp.ep`` engine), and ``register_llm`` (bindings).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, List, Optional
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, ModelEntry
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.llm.tokenizer import Tokenizer, load_tokenizer
+from dynamo_tpu.runtime.component import Endpoint
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.pipeline import link
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+logger = get_logger(__name__)
+
+
+class RouterEngine:
+    """Adapts a PushRouter (or KvPushRouter) to the AsyncEngine shape."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Annotated]:
+        return self.router.generate(request, context)
+
+
+def build_local_pipeline(tokenizer: Tokenizer, engine: AsyncEngine, card: Optional[ModelDeploymentCard] = None) -> AsyncEngine:
+    """Aggregated in-process pipeline: preprocessor → backend → engine
+    (ref: EngineConfig::StaticFull)."""
+    formatter = PromptFormatter(card.chat_template if card else None)
+    return link([OpenAIPreprocessor(tokenizer, formatter), Backend(tokenizer)], engine)
+
+
+def build_routed_pipeline(
+    tokenizer: Tokenizer,
+    router: PushRouter,
+    card: Optional[ModelDeploymentCard] = None,
+    *,
+    migration_limit: int = 0,
+) -> AsyncEngine:
+    """Frontend-side routed pipeline: preprocessor → backend → migration →
+    router (ref: input/common.rs:226)."""
+    formatter = PromptFormatter(card.chat_template if card else None)
+    ops = [OpenAIPreprocessor(tokenizer, formatter), Backend(tokenizer)]
+    limit = migration_limit if migration_limit else (card.migration_limit if card else 0)
+    if limit > 0:
+        ops.append(Migration(limit))
+    return link(ops, RouterEngine(router))
+
+
+async def register_llm(
+    drt: DistributedRuntime,
+    endpoint: Endpoint,
+    engine: AsyncEngine,
+    card: ModelDeploymentCard,
+    *,
+    stats_handler=None,
+) -> "tuple":
+    """Worker-side: serve the engine on the endpoint and publish the model
+    entry so frontends discover it (ref: register_llm + ModelEntry put,
+    SURVEY.md §3B)."""
+    handle = await endpoint.serve_endpoint(
+        engine.generate if hasattr(engine, "generate") else engine, stats_handler=stats_handler
+    )
+    entry = ModelEntry(
+        name=card.name,
+        namespace=endpoint.namespace,
+        component=endpoint.component,
+        endpoint=endpoint.name,
+        card=card,
+    )
+    await drt.store.put(entry.store_key, entry.to_json(), lease_id=handle.lease.id)
+    logger.info("registered model %s at %s", card.name, entry.store_key)
+    return handle, entry
+
+
+@dataclass
+class FrontendConfig:
+    """Mirrors the reference frontend CLI surface
+    (components/frontend main.py:81-286)."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    router_mode: str = "round-robin"  # round-robin | random | kv
+    busy_threshold: Optional[float] = None
+    migration_limit: int = 0
+    kv_overlap_score_weight: float = 1.0
+    kv_temperature: float = 0.0
+    namespace: str = "dynamo"
+
+
+async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> HttpService:
+    """Start the OpenAI frontend with dynamic model discovery: every model
+    registered in the KV store gets a routed pipeline."""
+    manager = ModelManager()
+
+    async def engine_factory(entry: ModelEntry) -> AsyncEngine:
+        ep = drt.namespace(entry.namespace).component(entry.component).endpoint(entry.endpoint)
+        client = await ep.client()
+        if config.router_mode == "kv":
+            from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
+
+            router = await KvPushRouter.create(
+                client,
+                KvRouterConfig(
+                    overlap_score_weight=config.kv_overlap_score_weight,
+                    temperature=config.kv_temperature,
+                    block_size=entry.card.kv_cache_block_size,
+                ),
+            )
+        else:
+            mode = RouterMode.RANDOM if config.router_mode == "random" else RouterMode.ROUND_ROBIN
+            router = PushRouter(client, mode)
+            if config.busy_threshold is not None:
+                router.monitor.busy_threshold = config.busy_threshold
+        tokenizer = load_tokenizer(entry.card.tokenizer_path)
+        return build_routed_pipeline(
+            tokenizer, router, entry.card, migration_limit=config.migration_limit
+        )
+
+    watcher = ModelWatcher(drt, manager, engine_factory)
+    await watcher.start()
+    service = HttpService(manager, host=config.host, port=config.port)
+    service.watcher = watcher  # keep alive / stoppable
+    await service.start()
+    return service

@@ -1,0 +1,1 @@
+"""HTTP frontend (ref: lib/llm/src/http/service)."""

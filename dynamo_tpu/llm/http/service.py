@@ -1,0 +1,277 @@
+"""OpenAI-compatible HTTP frontend.
+
+Ref: lib/llm/src/http/service/{openai.rs,service_v2.rs,metrics.rs,
+disconnect.rs} — routes ``/v1/chat/completions`` (openai.rs:481),
+``/v1/completions`` (:245), ``/v1/models``, SSE streaming with ``[DONE]``
+sentinel, client-disconnect → context cancellation (disconnect.rs), per-route
+metrics: TTFT/ITL histograms, inflight gauges (metrics.rs:1-700).
+
+Built on aiohttp (the axum role). The service is engine-agnostic: it looks
+up pipelines in the ModelManager, so aggregated single-process, routed
+multi-worker, and disaggregated deployments all serve through this one
+frontend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.protocols import openai as oai
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.logging import TraceParent, get_logger
+from dynamo_tpu.runtime.metrics import (
+    DURATION_BUCKETS,
+    FRONTEND_PREFIX,
+    ITL_BUCKETS,
+    TTFT_BUCKETS,
+    MetricsRegistry,
+)
+
+logger = get_logger(__name__)
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or MetricsRegistry(prefix=FRONTEND_PREFIX)
+        self._runner: Optional[web.AppRunner] = None
+
+        m = self.metrics
+        self._m_requests = lambda model, status: m.counter(
+            "requests_total", "HTTP requests", model=model, status=status
+        )
+        self._m_inflight = lambda model: m.gauge("inflight_requests", "in-flight requests", model=model)
+        self._m_ttft = lambda model: m.histogram(
+            "time_to_first_token_seconds", "TTFT", buckets=TTFT_BUCKETS, model=model
+        )
+        self._m_itl = lambda model: m.histogram(
+            "inter_token_latency_seconds", "ITL", buckets=ITL_BUCKETS, model=model
+        )
+        self._m_duration = lambda model: m.histogram(
+            "request_duration_seconds", "request duration", buckets=DURATION_BUCKETS, model=model
+        )
+        self._m_output_tokens = lambda model: m.counter("output_tokens_total", "output tokens", model=model)
+
+    # --- lifecycle ----------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.list_models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics_route)
+        app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.build_app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        logger.info("OpenAI HTTP frontend on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # --- routes -------------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.manager.list_models()})
+
+    async def metrics_route(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "created": int(time.time()), "owned_by": "dynamo-tpu"}
+                    for name in self.manager.list_models()
+                ],
+            }
+        )
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        # Ref: clear_kv_blocks.rs — forwarded to workers in the routed setup;
+        # local engines expose a hook via the manager entry.
+        results = {}
+        for name in self.manager.list_models():
+            engine = self.manager.get("chat", name) or self.manager.get("completions", name)
+            hook = getattr(engine, "clear_kv_blocks", None)
+            results[name] = "ok" if hook and await _maybe_await(hook()) is not None else "unsupported"
+        return web.json_response(results)
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="chat")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="completions")
+
+    # --- core serving path --------------------------------------------------
+    async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
+        model = "unknown"
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(oai.error_body("invalid JSON body"), status=400)
+        try:
+            body = oai.validate_chat_request(body) if kind == "chat" else oai.validate_completion_request(body)
+            model = body["model"]
+        except oai.RequestError as e:
+            self._m_requests(model, "400").inc()
+            return web.json_response(oai.error_body(str(e)), status=400)
+
+        engine = self.manager.get(kind, model) or self.manager.get(
+            "chat" if kind == "completions" else "completions", model
+        )
+        if engine is None:
+            self._m_requests(model, "404").inc()
+            return web.json_response(oai.error_body(f"model {model!r} not found", "model_not_found", 404), status=404)
+
+        stream = bool(body.get("stream", False))
+        ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
+        rid = oai.make_id("chatcmpl" if kind == "chat" else "cmpl")
+        start = time.monotonic()
+        self._m_inflight(model).inc()
+        try:
+            if stream:
+                return await self._serve_stream(request, engine, body, ctx, rid, kind, model, start)
+            return await self._serve_unary(engine, body, ctx, rid, kind, model, start)
+        finally:
+            self._m_inflight(model).dec()
+            self._m_duration(model).observe(time.monotonic() - start)
+
+    async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
+        text_parts = []
+        n_tokens = 0
+        finish_reason = "stop"
+        first_tok_at = None
+        try:
+            async for item in engine.generate(body, ctx):
+                out = _as_output(item)
+                if out is None:
+                    continue
+                if out.text:
+                    if first_tok_at is None:
+                        first_tok_at = time.monotonic()
+                        self._m_ttft(model).observe(first_tok_at - start)
+                    text_parts.append(out.text)
+                n_tokens += len(out.token_ids)
+                if out.finish_reason:
+                    finish_reason = out.finish_reason
+        except Exception as e:
+            logger.exception("request %s failed", ctx.id)
+            self._m_requests(model, "500").inc()
+            return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
+        self._m_requests(model, "200").inc()
+        self._m_output_tokens(model).inc(n_tokens)
+        usage = oai.usage_dict(prompt_tokens=0, completion_tokens=n_tokens)
+        text = "".join(text_parts)
+        if kind == "chat":
+            return web.json_response(oai.chat_response(rid, model, text, finish_reason, usage))
+        return web.json_response(oai.completion_response(rid, model, text, finish_reason, usage))
+
+    async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        first = True
+        prev_tok_at = None
+        n_tokens = 0
+        status = "200"
+        try:
+            if kind == "chat":
+                await _sse(resp, oai.chat_chunk(rid, model, {"role": "assistant", "content": ""}))
+            async for item in engine.generate(body, ctx):
+                if isinstance(item, Annotated) and item.is_annotation():
+                    await _sse_event(resp, item.event, item.comment)
+                    continue
+                out = _as_output(item)
+                if out is None:
+                    continue
+                now = time.monotonic()
+                if out.text or out.token_ids:
+                    if first:
+                        self._m_ttft(model).observe(now - start)
+                        first = False
+                    elif prev_tok_at is not None:
+                        self._m_itl(model).observe(now - prev_tok_at)
+                    prev_tok_at = now
+                    n_tokens += len(out.token_ids)
+                if out.text:
+                    if kind == "chat":
+                        await _sse(resp, oai.chat_chunk(rid, model, {"content": out.text}))
+                    else:
+                        await _sse(resp, oai.completion_chunk(rid, model, out.text))
+                if out.finish_reason:
+                    chunk = (
+                        oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason)
+                        if kind == "chat"
+                        else oai.completion_chunk(rid, model, "", finish_reason=out.finish_reason)
+                    )
+                    await _sse(resp, chunk)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: cancel into the pipeline (ref: disconnect.rs).
+            ctx.stop_generating()
+            status = "499"
+            raise
+        except Exception as e:
+            logger.exception("stream %s failed", ctx.id)
+            status = "500"
+            await _sse(resp, oai.error_body(str(e), "internal_error", 500))
+        finally:
+            self._m_requests(model, status).inc()
+            self._m_output_tokens(model).inc(n_tokens)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+def _as_output(item) -> Optional[LLMEngineOutput]:
+    if isinstance(item, Annotated):
+        if item.data is None:
+            return None
+        return LLMEngineOutput.from_wire(item.data)
+    if isinstance(item, dict):
+        return LLMEngineOutput.from_wire(item)
+    return None
+
+
+async def _sse(resp: web.StreamResponse, obj: dict) -> None:
+    await resp.write(b"data: " + json.dumps(obj, ensure_ascii=False).encode() + b"\n\n")
+
+
+async def _sse_event(resp: web.StreamResponse, event: str, comment: Optional[str]) -> None:
+    payload = json.dumps({"event": event, "comment": comment}, ensure_ascii=False).encode()
+    await resp.write(b"event: " + event.encode() + b"\ndata: " + payload + b"\n\n")
+
+
+async def _maybe_await(x):
+    if asyncio.iscoroutine(x):
+        return await x
+    return x

@@ -1,0 +1,78 @@
+"""Migration operator: replay in-flight requests on another worker when the
+response stream drops.
+
+Ref: lib/llm/src/migration.rs:26-734 (``Migration``, ``RetryManager``) — on
+stream drop, the accumulated output tokens are appended to the prompt and the
+request is re-pushed (the router picks a live instance), up to
+``migration_limit`` times (model_card.rs:136). The log line "recreating
+stream" is load-bearing: the reference's fault-tolerance test asserts it
+(tests/fault_tolerance/test_request_migration.py), so we keep it verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context, StreamDisconnect
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.pipeline import Operator
+from dynamo_tpu.runtime.push_router import NoInstancesError
+
+logger = get_logger(__name__)
+
+
+class Migration(Operator):
+    def __init__(self, migration_limit: int):
+        self.migration_limit = migration_limit
+
+    def attach(self, downstream: AsyncEngine) -> AsyncEngine:
+        return _MigrationEngine(self.migration_limit, downstream)
+
+
+class _MigrationEngine:
+    def __init__(self, limit: int, downstream: AsyncEngine):
+        self.limit = limit
+        self.downstream = downstream
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        attempts_left = self.limit
+        req = dict(request)
+        emitted_tokens = 0
+
+        while True:
+            try:
+                async for item in self.downstream.generate(req, context):
+                    out = item.data if isinstance(item, Annotated) else item
+                    if isinstance(out, dict) and out.get("token_ids"):
+                        emitted_tokens += len(out["token_ids"])
+                        # Fold emitted tokens into the replay request so a
+                        # migrated continuation resumes, not restarts.
+                        req = self._fold(req, out["token_ids"])
+                    yield item
+                return
+            except StreamDisconnect:
+                if attempts_left <= 0 or context.is_stopped():
+                    raise
+                attempts_left -= 1
+                logger.warning(
+                    "recreating stream for request %s (%d migrations left, %d tokens emitted)",
+                    context.id,
+                    attempts_left,
+                    emitted_tokens,
+                )
+            except NoInstancesError:
+                if attempts_left <= 0:
+                    raise
+                attempts_left -= 1
+                logger.warning("recreating stream for request %s: no instances yet", context.id)
+
+    @staticmethod
+    def _fold(req: dict, new_tokens) -> dict:
+        req = dict(req)
+        req["token_ids"] = list(req.get("token_ids") or []) + list(new_tokens)
+        stop = dict(req.get("stop_conditions") or {})
+        if stop.get("max_tokens"):
+            stop["max_tokens"] = max(1, stop["max_tokens"] - len(new_tokens))
+        req["stop_conditions"] = stop
+        return req

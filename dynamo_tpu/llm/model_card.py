@@ -1,0 +1,72 @@
+"""ModelDeploymentCard: the per-model config record published to discovery.
+
+Ref: lib/llm/src/model_card.rs:91 — tokenizer, prompt formatter, context
+length, kv block size, ``migration_limit`` (:136), runtime config; stored in
+the KV store under ``models/`` (discovery/model_entry.rs:22 MODEL_ROOT_PATH)
+and watched by frontends (ModelWatcher).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+MODEL_ROOT_PATH = "models"
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str
+    model_type: str = "chat"  # chat | completions | embeddings
+    tokenizer_path: Optional[str] = None
+    chat_template: Optional[str] = None
+    context_length: int = 8192
+    kv_cache_block_size: int = 16
+    migration_limit: int = 0
+    runtime_config: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ModelDeploymentCard":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class ModelEntry:
+    """Discovery record: model name → serving endpoint + card
+    (ref: discovery/model_entry.rs:22)."""
+
+    name: str
+    namespace: str
+    component: str
+    endpoint: str
+    card: ModelDeploymentCard
+
+    @property
+    def store_key(self) -> str:
+        return f"{MODEL_ROOT_PATH}/{self.namespace}/{self.component}/{self.endpoint}/{self.name}"
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "name": self.name,
+                "namespace": self.namespace,
+                "component": self.component,
+                "endpoint": self.endpoint,
+                "card": self.card.__dict__,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ModelEntry":
+        d = json.loads(raw)
+        return cls(
+            name=d["name"],
+            namespace=d["namespace"],
+            component=d["component"],
+            endpoint=d["endpoint"],
+            card=ModelDeploymentCard(**d["card"]),
+        )

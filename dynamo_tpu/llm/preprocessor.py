@@ -1,0 +1,126 @@
+"""OpenAI→internal preprocessing: chat template render + tokenization +
+sampling/stop extraction.
+
+Ref: lib/llm/src/preprocessor.rs — ``OpenAIPreprocessor`` :143,
+``preprocess_request`` :194, ``apply_template`` :258 (minijinja; here
+jinja2), annotation emission (``formatted_prompt``, ``token_ids``).
+
+Runs as a pipeline Operator on the frontend so workers only ever see
+token ids (PreprocessedRequest) — the wire stays text-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, List, Optional
+
+import jinja2
+
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    sampling_from_request,
+    stop_conditions_from_request,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.pipeline import Operator
+
+# Generic fallback template (model-specific templates come from
+# tokenizer_config.json via the MDC).
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>\n{{ message.content }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+
+
+class PromptFormatter:
+    """Jinja chat-template renderer (ref: preprocessor/prompt/*)."""
+
+    def __init__(self, template: Optional[str] = None, bos_token: str = "", eos_token: str = ""):
+        self.env = jinja2.Environment(keep_trailing_newline=True)
+        self.env.globals["raise_exception"] = self._raise
+        self.template = self.env.from_string(template or DEFAULT_CHAT_TEMPLATE)
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+
+    @staticmethod
+    def _raise(msg: str):
+        raise ValueError(msg)
+
+    def render(self, messages: List[dict], add_generation_prompt: bool = True, **extra: Any) -> str:
+        return self.template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self.bos_token,
+            eos_token=self.eos_token,
+            **extra,
+        )
+
+
+class OpenAIPreprocessor(Operator):
+    """Chat/completion request → PreprocessedRequest (wire dict)."""
+
+    def __init__(
+        self,
+        tokenizer: Tokenizer,
+        formatter: Optional[PromptFormatter] = None,
+        *,
+        default_max_tokens: int = 512,
+    ):
+        self.tokenizer = tokenizer
+        self.formatter = formatter or PromptFormatter(getattr(tokenizer, "chat_template", None))
+        self.default_max_tokens = default_max_tokens
+
+    # --- Operator interface -------------------------------------------------
+    async def transform_request(self, request: dict, context: Context) -> dict:
+        req, prompt = self.preprocess(request)
+        wire = req.to_wire()
+        wire["annotations"] = req.annotations
+        # Side-band for the response annotation path; engines ignore it.
+        wire["_formatted_prompt"] = prompt
+        return wire
+
+    def transform_response(self, stream: AsyncIterator, request: dict, context: Context) -> AsyncIterator:
+        annotations = request.get("annotations") or []
+
+        async def gen():
+            # Requested annotations are emitted before engine output
+            # (ref: preprocessor.rs annotations path).
+            if ANNOTATION_FORMATTED_PROMPT in annotations and request.get("_formatted_prompt") is not None:
+                yield Annotated(event=ANNOTATION_FORMATTED_PROMPT, comment=request["_formatted_prompt"])
+            if ANNOTATION_TOKEN_IDS in annotations:
+                yield Annotated(event=ANNOTATION_TOKEN_IDS, comment=str(request.get("token_ids")))
+            async for item in stream:
+                yield item
+
+        return gen()
+
+    # --- core ---------------------------------------------------------------
+    def preprocess(self, body: dict) -> PreprocessedRequest:
+        if "messages" in body:
+            prompt = self.formatter.render(body["messages"], add_generation_prompt=True)
+            token_ids = self.tokenizer.encode(prompt)
+        else:
+            raw = body.get("prompt", "")
+            if isinstance(raw, list) and raw and isinstance(raw[0], int):
+                prompt, token_ids = None, list(raw)
+            else:
+                prompt = raw if isinstance(raw, str) else "\n".join(raw)
+                token_ids = self.tokenizer.encode(prompt)
+
+        nvext = body.get("nvext") or {}
+        stop_conditions = stop_conditions_from_request(body)
+        if stop_conditions.get("max_tokens") is None:
+            stop_conditions["max_tokens"] = self.default_max_tokens
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            sampling_options=sampling_from_request(body),
+            stop_conditions=stop_conditions,
+            annotations=list(nvext.get("annotations") or []),
+            model=body.get("model", ""),
+            router_overrides=nvext.get("router") or {},
+        ), prompt

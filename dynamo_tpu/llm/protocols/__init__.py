@@ -1,0 +1,2 @@
+"""Wire protocols: OpenAI API types + internal request/response shapes
+(ref: lib/llm/src/protocols — SURVEY.md §2b)."""

@@ -1,0 +1,115 @@
+"""Internal protocol types shared across the pipeline.
+
+Ref: lib/llm/src/protocols/common/* — ``PreprocessedRequest`` (the
+tokenized, template-rendered form that crosses the wire to workers),
+``LLMEngineOutput`` (per-step engine emission), StopConditions,
+SamplingOptions. Kept as plain dicts on the wire (msgpack/json friendly);
+these dataclasses are the typed construction/validation layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class SamplingOptions:
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+
+    def to_wire(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+@dataclass
+class StopConditionsSpec:
+    max_tokens: Optional[int] = None
+    min_tokens: Optional[int] = None
+    stop: List[str] = field(default_factory=list)  # stop strings (backend-jailed)
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+
+    def to_wire(self) -> dict:
+        return {
+            "max_tokens": self.max_tokens,
+            "min_tokens": self.min_tokens,
+            "stop": self.stop,
+            "stop_token_ids": self.stop_token_ids,
+            "ignore_eos": self.ignore_eos,
+        }
+
+
+@dataclass
+class PreprocessedRequest:
+    """What the frontend sends to workers (ref: protocols/common
+    PreprocessedRequest): token ids + sampling + stop conditions +
+    annotations. ``router_overrides`` mirrors nvext per-request router
+    config (kv_router.rs:86 RouterConfigOverride)."""
+
+    token_ids: List[int]
+    sampling_options: Dict[str, Any] = field(default_factory=dict)
+    stop_conditions: Dict[str, Any] = field(default_factory=dict)
+    annotations: List[str] = field(default_factory=list)
+    model: str = ""
+    router_overrides: Dict[str, Any] = field(default_factory=dict)
+    # Disaggregation: set by the decode worker when forwarding to prefill.
+    disagg_params: Dict[str, Any] = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return {
+            "token_ids": self.token_ids,
+            "sampling_options": self.sampling_options,
+            "stop_conditions": self.stop_conditions,
+            "annotations": self.annotations,
+            "model": self.model,
+            "router_overrides": self.router_overrides,
+            "disagg_params": self.disagg_params,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d.get("token_ids") or []),
+            sampling_options=d.get("sampling_options") or {},
+            stop_conditions=d.get("stop_conditions") or {},
+            annotations=list(d.get("annotations") or []),
+            model=d.get("model", ""),
+            router_overrides=d.get("router_overrides") or {},
+            disagg_params=d.get("disagg_params") or {},
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """Per-step engine emission (ref: protocols/common LLMEngineOutput)."""
+
+    token_ids: List[int] = field(default_factory=list)
+    text: Optional[str] = None  # set by the Backend detokenizer
+    finish_reason: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    index: int = 0
+
+    def to_wire(self) -> dict:
+        d: Dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
+        if self.text is not None:
+            d["text"] = self.text
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason
+        if self.cum_log_probs is not None:
+            d["cum_log_probs"] = self.cum_log_probs
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "LLMEngineOutput":
+        return cls(
+            token_ids=list(d.get("token_ids") or []),
+            text=d.get("text"),
+            finish_reason=d.get("finish_reason"),
+            cum_log_probs=d.get("cum_log_probs"),
+            index=d.get("index", 0),
+        )

@@ -1,0 +1,167 @@
+"""OpenAI API protocol: request validation + response/chunk builders.
+
+Ref: lib/llm/src/protocols/openai/{chat_completions,completions}/* and the
+async-openai fork (lib/async-openai, SURVEY.md N5) — here the wire format is
+handled as validated dicts (BYOT-style) rather than a type-per-field fork;
+``validate.rs`` checks are mirrored in :func:`validate_chat_request`.
+
+``nvext`` (protocols/openai/nvext.rs) per-request extensions are accepted
+under the same key: ``{"nvext": {"annotations": [...], "router": {...}}}``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class RequestError(ValueError):
+    """400-class protocol violation."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise RequestError(msg)
+
+
+def validate_chat_request(body: dict) -> dict:
+    _require(isinstance(body, dict), "body must be a JSON object")
+    _require(bool(body.get("model")), "missing required field: model")
+    messages = body.get("messages")
+    _require(isinstance(messages, list) and len(messages) > 0, "messages must be a non-empty array")
+    for m in messages:
+        _require(isinstance(m, dict) and "role" in m, "each message needs a role")
+        _require(m["role"] in ("system", "user", "assistant", "tool", "developer"), f"invalid role {m['role']!r}")
+    for key in ("temperature", "top_p", "frequency_penalty", "presence_penalty"):
+        v = body.get(key)
+        _require(v is None or isinstance(v, (int, float)), f"{key} must be a number")
+    t = body.get("temperature")
+    _require(t is None or 0.0 <= t <= 2.0, "temperature must be in [0, 2]")
+    tp = body.get("top_p")
+    _require(tp is None or 0.0 < tp <= 1.0, "top_p must be in (0, 1]")
+    mt = body.get("max_tokens") or body.get("max_completion_tokens")
+    _require(mt is None or (isinstance(mt, int) and mt > 0), "max_tokens must be a positive integer")
+    n = body.get("n")
+    _require(n is None or n == 1, "n > 1 is not supported")
+    stop = body.get("stop")
+    _require(
+        stop is None or isinstance(stop, str) or (isinstance(stop, list) and all(isinstance(s, str) for s in stop)),
+        "stop must be a string or array of strings",
+    )
+    return body
+
+
+def validate_completion_request(body: dict) -> dict:
+    _require(isinstance(body, dict), "body must be a JSON object")
+    _require(bool(body.get("model")), "missing required field: model")
+    prompt = body.get("prompt")
+    _require(
+        isinstance(prompt, str)
+        or (isinstance(prompt, list) and all(isinstance(p, (str, int)) for p in prompt)),
+        "prompt must be a string, array of strings, or array of token ids",
+    )
+    return body
+
+
+def sampling_from_request(body: dict) -> Dict[str, Any]:
+    return {
+        k: body.get(k)
+        for k in ("temperature", "top_p", "top_k", "seed", "frequency_penalty", "presence_penalty")
+        if body.get(k) is not None
+    }
+
+
+def stop_conditions_from_request(body: dict, eos_token_ids: Optional[List[int]] = None) -> Dict[str, Any]:
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        stop = [stop]
+    return {
+        "max_tokens": body.get("max_tokens") or body.get("max_completion_tokens"),
+        "min_tokens": body.get("min_tokens"),
+        "stop": stop or [],
+        "stop_token_ids": body.get("stop_token_ids") or [],
+        "ignore_eos": bool((body.get("nvext") or {}).get("ignore_eos", False)),
+    }
+
+
+# --- response builders ------------------------------------------------------
+
+
+def make_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+def chat_chunk(
+    rid: str,
+    model: str,
+    delta: dict,
+    finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    out = {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def chat_response(
+    rid: str,
+    model: str,
+    text: str,
+    finish_reason: str,
+    usage: dict,
+) -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage,
+    }
+
+
+def completion_chunk(rid: str, model: str, text: str, finish_reason: Optional[str] = None) -> dict:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+    }
+
+
+def completion_response(rid: str, model: str, text: str, finish_reason: str, usage: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "usage": usage,
+    }
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def error_body(message: str, err_type: str = "invalid_request_error", code: int = 400) -> dict:
+    return {"error": {"message": message, "type": err_type, "code": code}}

@@ -1,0 +1,98 @@
+"""Unit tests: chat-template rendering, tokenization, incremental
+detokenization, stop-string jailing."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, StopStringJail
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.runtime.engine import Annotated, Context
+
+
+def test_prompt_formatter_default_template():
+    f = PromptFormatter()
+    out = f.render([{"role": "system", "content": "be brief"}, {"role": "user", "content": "hi"}])
+    assert "<|system|>" in out and "be brief" in out
+    assert out.rstrip().endswith("<|assistant|>")
+
+
+def test_prompt_formatter_custom_template():
+    f = PromptFormatter("{% for m in messages %}[{{m.role}}]{{m.content}}{% endfor %}")
+    assert f.render([{"role": "user", "content": "x"}]) == "[user]x"
+
+
+def test_preprocess_chat():
+    p = OpenAIPreprocessor(ByteTokenizer())
+    req, prompt = p.preprocess(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 7,
+            "temperature": 0.5,
+            "stop": ["\n\n"],
+        }
+    )
+    assert req.token_ids == ByteTokenizer().encode(prompt)
+    assert req.stop_conditions["max_tokens"] == 7
+    assert req.stop_conditions["stop"] == ["\n\n"]
+    assert req.sampling_options["temperature"] == 0.5
+
+
+def test_preprocess_completion_with_token_ids():
+    p = OpenAIPreprocessor(ByteTokenizer())
+    req, prompt = p.preprocess({"model": "m", "prompt": [5, 6, 7]})
+    assert req.token_ids == [5, 6, 7] and prompt is None
+
+
+def test_decode_stream_incremental():
+    tok = ByteTokenizer()
+    ds = DecodeStream(tok)
+    text = "héllo wörld"
+    ids = tok.encode(text)
+    out = ""
+    for i in ids:
+        out += ds.step([i])
+    assert out == text  # multibyte chars held until complete
+
+
+def test_stop_jail_immediate_hit():
+    jail = StopStringJail(["STOP"])
+    emit, hit = jail.feed("abcSTOPxyz")
+    assert emit == "abc" and hit
+
+
+def test_stop_jail_split_across_deltas():
+    jail = StopStringJail(["STOP"])
+    emit, hit = jail.feed("abcST")
+    assert emit == "abc" and not hit
+    emit, hit = jail.feed("OP")
+    assert emit is None and hit
+
+
+def test_stop_jail_false_alarm_releases():
+    jail = StopStringJail(["STOP"])
+    emit, hit = jail.feed("xyST")
+    assert emit == "xy" and not hit
+    emit, hit = jail.feed("ATIC")
+    assert emit == "STATIC" and not hit
+
+
+async def test_backend_stop_string_ends_stream():
+    tok = ByteTokenizer()
+    backend = Backend(tok)
+
+    async def engine_stream():
+        for chunk in ["he", "llo ST", "OP more"]:
+            yield Annotated(data=LLMEngineOutput(token_ids=tok.encode(chunk)).to_wire())
+        yield Annotated(data=LLMEngineOutput(finish_reason="length").to_wire())
+
+    ctx = Context()
+    request = {"stop_conditions": {"stop": ["STOP"]}}
+    outs = []
+    async for item in backend.transform_response(engine_stream(), request, ctx):
+        outs.append(LLMEngineOutput.from_wire(item.data))
+    text = "".join(o.text or "" for o in outs)
+    assert text == "hello "
+    assert outs[-1].finish_reason == "stop"
+    assert ctx.is_stopped()  # backend propagates abort upstream
