@@ -1,0 +1,176 @@
+"""KV-aware request routing (ref: lib/llm/src/kv_router — SURVEY.md §2b).
+
+``KvPushRouter`` wraps the plain PushRouter with KV-aware worker selection:
+prompt block hashes → radix-tree overlap per worker → cost function over
+(prefill need, decode load) → softmax/argmin choice → direct-routed push.
+State maintenance: durable KV-event stream feeds the indexer (exact mode) or
+routing decisions feed a TTL index (approx mode); worker metrics gossip
+corrects load; instance death prunes both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+    kv_events_stream_name,
+    kv_metrics_subject,
+)
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, SchedulingDecision
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.llm.kv_router.subscriber import KvRouterSubscriber
+from dynamo_tpu.llm.tokens import compute_block_hashes
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "KvRouterConfig",
+    "KvPushRouter",
+    "KvIndexer",
+    "ApproxKvIndexer",
+    "RadixTree",
+    "OverlapScores",
+    "KvScheduler",
+    "ActiveSequencesMultiWorker",
+    "KvEventPublisher",
+    "WorkerMetricsPublisher",
+    "KvRouterSubscriber",
+    "kv_events_stream_name",
+    "kv_metrics_subject",
+]
+
+
+@dataclass
+class KvRouterConfig:
+    """Ref: kv_router.rs:96 KvRouterConfig + per-request overrides (:86)."""
+
+    overlap_score_weight: float = 1.0
+    temperature: float = 0.0
+    block_size: int = 16
+    use_kv_events: bool = True  # False → ApproxKvIndexer
+    approx_ttl_s: float = 120.0
+    snapshot_threshold: int = 1_000_000
+    reset_states: bool = False
+
+
+class KvPushRouter:
+    """AsyncEngine-shaped KV router (ref: kv_router.rs KvPushRouter)."""
+
+    def __init__(self, client: Client, config: KvRouterConfig):
+        self.client = client
+        self.config = config
+        self.push = PushRouter(client, RouterMode.DIRECT)
+        self.sequences = ActiveSequencesMultiWorker(block_size=config.block_size)
+        self.scheduler = KvScheduler(
+            self.sequences,
+            overlap_score_weight=config.overlap_score_weight,
+            temperature=config.temperature,
+        )
+        if config.use_kv_events:
+            self.indexer: KvIndexer = KvIndexer(block_size=config.block_size)
+        else:
+            self.indexer = ApproxKvIndexer(block_size=config.block_size, ttl_s=config.approx_ttl_s)
+        self.subscriber: Optional[KvRouterSubscriber] = None
+        self._metrics_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(cls, client: Client, config: Optional[KvRouterConfig] = None) -> "KvPushRouter":
+        config = config or KvRouterConfig()
+        router = cls(client, config)
+        ep = client.endpoint
+        if config.use_kv_events:
+            router.subscriber = KvRouterSubscriber(
+                client.drt,
+                router.indexer,
+                kv_events_stream_name(ep.namespace, ep.component),
+                snapshot_threshold=config.snapshot_threshold,
+                reset_states=config.reset_states,
+            )
+            await router.subscriber.start()
+        router._metrics_task = asyncio.get_running_loop().create_task(router._consume_metrics())
+        return router
+
+    async def _consume_metrics(self) -> None:
+        """Worker load gossip → busy-threshold monitor (ref: scheduler.rs
+        watch channels + worker_monitor.rs)."""
+        ep = self.client.endpoint
+        sub = await self.client.drt.bus.subscribe(kv_metrics_subject(ep.namespace, ep.component))
+        try:
+            async for msg in sub:
+                try:
+                    m = json.loads(msg.data)
+                    self.push.monitor.update(int(m["worker_id"]), float(m.get("kv_usage", 0.0)))
+                except (ValueError, KeyError):
+                    continue
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await sub.unsubscribe()
+
+    def _sync_workers(self) -> list:
+        """Reconcile tracked state with the live instance set."""
+        live = self.client.instance_ids()
+        live_set = set(live)
+        for w in list(self.sequences._prefill_tokens):
+            if w not in live_set:
+                self.sequences.remove_worker(w)
+                self.indexer.remove_worker(w)
+        for w in live:
+            self.sequences.ensure_worker(w)
+        return live
+
+    async def schedule(self, token_ids, router_overrides: Optional[dict] = None) -> SchedulingDecision:
+        workers = self._sync_workers()
+        hashes = compute_block_hashes(token_ids, self.config.block_size)
+        prompt_blocks = max(1, (len(token_ids) + self.config.block_size - 1) // self.config.block_size)
+        overlaps = self.indexer.find_matches(hashes)
+        overrides = router_overrides or {}
+        return self.scheduler.select_worker(
+            workers,
+            prompt_blocks,
+            overlaps,
+            overlap_score_weight=overrides.get("overlap_score_weight"),
+            temperature=overrides.get("temperature"),
+        )
+
+    async def generate(self, request: Any, context: Optional[Context] = None) -> AsyncIterator[Annotated]:
+        ctx = context or Context()
+        token_ids = list(request.get("token_ids") or [])
+        decision = await self.schedule(token_ids, request.get("router_overrides"))
+        rid = ctx.id
+        self.sequences.add_request(rid, decision.worker, len(token_ids), decision.overlap_blocks)
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.process_routing_decision(decision.worker, token_ids)
+        logger.debug(
+            "kv-routed %s -> %x (overlap=%d blocks, cost=%.1f)", rid, decision.worker, decision.overlap_blocks, decision.cost
+        )
+        first = True
+        try:
+            async for item in self.push.generate(request, ctx, instance_id=decision.worker):
+                if first and (not isinstance(item, Annotated) or not item.is_annotation()):
+                    self.sequences.mark_prefill_done(rid)
+                    first = False
+                yield item
+        finally:
+            self.sequences.free(rid)
+
+    async def close(self) -> None:
+        if self.subscriber is not None:
+            await self.subscriber.stop()
+        if self._metrics_task is not None:
+            self._metrics_task.cancel()
+            try:
+                await self._metrics_task
+            except asyncio.CancelledError:
+                pass

@@ -1,0 +1,103 @@
+"""Router-side background: consume KV events into the indexer; snapshot +
+purge for bounded replay.
+
+Ref: lib/llm/src/kv_router/subscriber.rs:71 ``start_kv_router_background`` —
+on startup download the radix snapshot from the object store
+(``radix-bucket``, kv_router.rs:69), then consume the durable stream; past
+``router_snapshot_threshold`` events, upload a fresh snapshot under the
+store lock (``router-snapshot-lock``) and purge the stream so replicas
+resync cheaply.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.transports.kvstore import KeyExists
+
+logger = get_logger(__name__)
+
+RADIX_STATE_BUCKET = "radix-bucket"
+ROUTER_SNAPSHOT_LOCK = "locks/router-snapshot"
+
+
+class KvRouterSubscriber:
+    def __init__(
+        self,
+        drt,
+        indexer: KvIndexer,
+        stream_name: str,
+        *,
+        snapshot_threshold: int = 1_000_000,
+        reset_states: bool = False,
+    ):
+        self.drt = drt
+        self.indexer = indexer
+        self.stream_name = stream_name
+        self.snapshot_threshold = snapshot_threshold
+        self.reset_states = reset_states
+        self._task: Optional[asyncio.Task] = None
+        self._events_since_snapshot = 0
+        self._consumed_seq = 0
+
+    async def start(self) -> None:
+        bucket = await self.drt.bus.object_store(RADIX_STATE_BUCKET)
+        if self.reset_states:
+            await bucket.delete(self.stream_name)
+            stream = await self.drt.bus.stream(self.stream_name)
+            await stream.purge()
+        else:
+            snap = await bucket.get(self.stream_name)
+            if snap is not None:
+                try:
+                    self.indexer.tree = RadixTree.load(snap)
+                    logger.info("restored radix snapshot: %d nodes", self.indexer.tree.size())
+                except Exception:
+                    logger.exception("radix snapshot restore failed; starting empty")
+        self._task = asyncio.get_running_loop().create_task(self._consume())
+
+    async def _consume(self) -> None:
+        stream = await self.drt.bus.stream(self.stream_name)
+        try:
+            async for msg in stream.consume(from_seq=1):
+                try:
+                    event = json.loads(msg.data)
+                    self.indexer.apply_event(int(event["worker_id"]), event)
+                except (ValueError, KeyError):
+                    logger.warning("malformed kv event on %s", self.stream_name)
+                self._consumed_seq = msg.seq
+                self._events_since_snapshot += 1
+                if self._events_since_snapshot >= self.snapshot_threshold:
+                    await self._snapshot(stream)
+        except asyncio.CancelledError:
+            pass
+
+    async def _snapshot(self, stream) -> None:
+        """Upload snapshot + purge, single-writer via a store lock
+        (ref: ROUTER_SNAPSHOT_LOCK kv_router.rs:71)."""
+        self._events_since_snapshot = 0
+        try:
+            await self.drt.store.put(ROUTER_SNAPSHOT_LOCK, b"1", create_only=True)
+        except KeyExists:
+            return  # another replica is snapshotting
+        try:
+            bucket = await self.drt.bus.object_store(RADIX_STATE_BUCKET)
+            await bucket.put(self.stream_name, self.indexer.tree.dump())
+            await stream.purge(up_to_seq=self._consumed_seq)
+            logger.info("radix snapshot uploaded (%d nodes), stream purged to %d",
+                        self.indexer.tree.size(), self._consumed_seq)
+        finally:
+            await self.drt.store.delete(ROUTER_SNAPSHOT_LOCK)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -1,0 +1,122 @@
+"""Mocker engine: full engine emulation with no TPU.
+
+Ref: lib/llm/src/mocker/* (3,226 LoC) — ``MockVllmEngine`` (engine.rs:48)
+simulates prefill/decode timing, KV block allocation with prefix caching, and
+KV events at ``speedup_ratio``; the reference's distributed test suite runs
+whole router/frontend topologies against fleets of these (SURVEY.md §4 — the
+single highest-leverage test asset).
+
+This mocker reuses the *real* BlockAllocator + chained hashing, so its KV
+events and prefix-cache hit behavior are bit-identical to the real engine's;
+only the compute is replaced by sleeps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, List, Optional
+
+from dynamo_tpu.engine.kv_cache import BlockAllocator, KvEvent, OutOfBlocksError
+from dynamo_tpu.engine.scheduler import ForwardPassMetrics
+from dynamo_tpu.llm.tokens import compute_block_hashes
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class MockEngineArgs:
+    """Ref: mocker/protocols.rs:67 MockEngineArgs."""
+
+    block_size: int = 16
+    num_blocks: int = 512
+    max_batch: int = 32
+    speedup_ratio: float = 1.0
+    prefill_time_per_token_ms: float = 0.05
+    decode_time_per_token_ms: float = 5.0
+    watermark: float = 0.01
+
+
+class MockTpuEngine:
+    """AsyncEngine-shaped engine emulator."""
+
+    def __init__(self, args: Optional[MockEngineArgs] = None, *, kv_event_sink: Optional[Callable[[KvEvent], None]] = None):
+        self.args = args or MockEngineArgs()
+        self._sink = kv_event_sink
+        self.allocator = BlockAllocator(self.args.num_blocks, on_event=self._on_event)
+        self._batch = asyncio.Semaphore(self.args.max_batch)
+        self._active = 0
+        self._waiting = 0
+        self.request_total = 0
+        self.prefill_tokens_done = 0
+
+    def _on_event(self, ev: KvEvent) -> None:
+        if self._sink is not None:
+            self._sink(ev)
+
+    def set_kv_event_sink(self, sink: Callable[[KvEvent], None]) -> None:
+        self._sink = sink
+
+    # --- AsyncEngine --------------------------------------------------------
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        args = self.args
+        tokens: List[int] = list(request.get("token_ids") or [])
+        stop = request.get("stop_conditions") or {}
+        max_tokens = int(stop.get("max_tokens") or 16)
+        self.request_total += 1
+        self._waiting += 1
+        async with self._batch:
+            self._waiting -= 1
+            self._active += 1
+            block_ids: List[int] = []
+            try:
+                hashes = compute_block_hashes(tokens, args.block_size)
+                matched = self.allocator.match_prefix(hashes)
+                cached_tokens = len(matched) * args.block_size
+                block_ids = list(matched)
+                needed = (len(tokens) + max_tokens + args.block_size - 1) // args.block_size - len(block_ids)
+                while needed > 0:
+                    try:
+                        block_ids.extend(self.allocator.allocate(needed))
+                        needed = 0
+                    except OutOfBlocksError:
+                        await asyncio.sleep(0.005 / args.speedup_ratio)  # backpressure
+                        if context.is_stopped():
+                            return
+
+                # Prefill: time proportional to uncached tokens.
+                uncached = max(0, len(tokens) - cached_tokens)
+                await asyncio.sleep(uncached * args.prefill_time_per_token_ms / 1000.0 / args.speedup_ratio)
+                self.prefill_tokens_done += uncached
+                n_full = len(hashes)
+                self.allocator.register_hashes(block_ids[:n_full], hashes)
+
+                # Decode: one token per step at the configured ITL.
+                for i in range(max_tokens):
+                    if context.is_stopped():
+                        yield {"token_ids": [], "finish_reason": "cancelled", "index": 0}
+                        return
+                    await asyncio.sleep(args.decode_time_per_token_ms / 1000.0 / args.speedup_ratio)
+                    token = tokens[i % len(tokens)] if tokens else i
+                    finish = "length" if i == max_tokens - 1 else None
+                    yield {"token_ids": [token], "finish_reason": finish, "index": 0}
+            finally:
+                self.allocator.release(block_ids)
+                self._active -= 1
+
+    # --- stats --------------------------------------------------------------
+    def metrics(self) -> ForwardPassMetrics:
+        return ForwardPassMetrics(
+            num_running=self._active,
+            num_waiting=self._waiting,
+            kv_usage=self.allocator.usage(),
+            kv_total_blocks=self.allocator.num_blocks,
+            kv_active_blocks=self.allocator.num_active,
+            request_total=self.request_total,
+        )
+
+    def stats_handler(self) -> dict:
+        m = self.metrics()
+        return {"kv_usage": m.kv_usage, "num_running": m.num_running, "num_waiting": m.num_waiting}

@@ -1,0 +1,140 @@
+"""Unit tests for the KV router stack: radix indexer, scheduler cost
+function, approx indexer, active sequences, snapshot round-trip."""
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    ActiveSequencesMultiWorker,
+    ApproxKvIndexer,
+    KvIndexer,
+    KvScheduler,
+    RadixTree,
+)
+from dynamo_tpu.llm.tokens import compute_block_hashes
+
+BS = 16
+
+
+def hashes_for(tokens):
+    return compute_block_hashes(tokens, BS)
+
+
+def test_radix_tree_store_and_match():
+    tree = RadixTree()
+    seq = list(range(64))  # 4 blocks
+    h = hashes_for(seq)
+    tree.apply_stored(1, h, None)
+    tree.apply_stored(2, h[:2], None)
+
+    scores = tree.find_matches(h)
+    assert scores.scores == {1: 4, 2: 2}
+
+    # Diverging suffix matches only the shared prefix.
+    other = seq[:32] + list(range(1000, 1032))
+    scores2 = tree.find_matches(hashes_for(other))
+    assert scores2.scores == {1: 2, 2: 2}
+
+
+def test_radix_tree_incremental_store_with_parent():
+    tree = RadixTree()
+    seq = list(range(64))
+    h = hashes_for(seq)
+    tree.apply_stored(1, h[:2], None)
+    tree.apply_stored(1, h[2:], h[1])  # chained continuation
+    assert tree.find_matches(h).scores == {1: 4}
+
+
+def test_radix_tree_removed_and_prune():
+    tree = RadixTree()
+    h = hashes_for(list(range(64)))
+    tree.apply_stored(1, h, None)
+    tree.apply_removed(1, h[2:])
+    assert tree.find_matches(h).scores == {1: 2}
+    assert tree.size() == 2  # pruned leaves
+
+
+def test_radix_tree_remove_worker():
+    tree = RadixTree()
+    h = hashes_for(list(range(32)))
+    tree.apply_stored(1, h, None)
+    tree.apply_stored(2, h, None)
+    tree.remove_worker(1)
+    assert tree.find_matches(h).scores == {2: 2}
+
+
+def test_radix_snapshot_roundtrip():
+    tree = RadixTree()
+    a = hashes_for(list(range(64)))
+    b = hashes_for(list(range(500, 532)))
+    tree.apply_stored(1, a, None)
+    tree.apply_stored(2, b, None)
+    restored = RadixTree.load(tree.dump())
+    assert restored.find_matches(a).scores == {1: 4}
+    assert restored.find_matches(b).scores == {2: 2}
+    assert restored.size() == tree.size()
+
+
+def test_scheduler_prefers_overlap():
+    seqs = ActiveSequencesMultiWorker(block_size=BS)
+    sched = KvScheduler(seqs)
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    # Worker 1 holds 4 of 6 blocks; worker 2 none. Equal load.
+    d = sched.select_worker([1, 2], prompt_blocks=6, overlaps=OverlapScores(scores={1: 4}))
+    assert d.worker == 1 and d.overlap_blocks == 4
+
+
+def test_scheduler_load_beats_small_overlap():
+    seqs = ActiveSequencesMultiWorker(block_size=BS)
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    sched = KvScheduler(seqs)
+    # Worker 1 has 1 block overlap but is heavily loaded with decode work.
+    for i in range(20):
+        seqs.add_request(f"r{i}", 1, prompt_tokens=64, overlap_blocks=0)
+    d = sched.select_worker([1, 2], prompt_blocks=4, overlaps=OverlapScores(scores={1: 1}))
+    assert d.worker == 2
+
+
+def test_scheduler_softmax_temperature_spreads():
+    seqs = ActiveSequencesMultiWorker(block_size=BS)
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    sched = KvScheduler(seqs, temperature=5.0)
+    chosen = {sched.select_worker([1, 2, 3], 4, OverlapScores()).worker for _ in range(50)}
+    assert len(chosen) > 1  # high temperature spreads across equal workers
+
+
+def test_active_sequences_lifecycle():
+    seqs = ActiveSequencesMultiWorker(block_size=BS)
+    seqs.add_request("r1", 7, prompt_tokens=64, overlap_blocks=2)
+    assert seqs.prefill_tokens(7) == 32  # 64 - 2*16 cached
+    assert seqs.decode_blocks(7) == 4
+    seqs.mark_prefill_done("r1")
+    assert seqs.prefill_tokens(7) == 0
+    assert seqs.decode_blocks(7) == 4
+    assert seqs.free("r1") == 7
+    assert seqs.decode_blocks(7) == 0
+
+
+def test_approx_indexer_ttl():
+    idx = ApproxKvIndexer(block_size=BS, ttl_s=0.0)  # immediate expiry
+    tokens = list(range(32))
+    idx.process_routing_decision(5, tokens)
+    # expire() runs inside find_matches; ttl=0 ⇒ gone.
+    assert idx.find_matches(hashes_for(tokens)).scores == {}
+
+    idx2 = ApproxKvIndexer(block_size=BS, ttl_s=60.0)
+    idx2.process_routing_decision(5, tokens)
+    assert idx2.find_matches(hashes_for(tokens)).scores == {5: 2}
+
+
+def test_indexer_event_application():
+    idx = KvIndexer(block_size=BS)
+    h = hashes_for(list(range(48)))
+    idx.apply_event(9, {"kind": "stored", "block_hashes": h, "parent_hash": None})
+    assert idx.find_matches_for_tokens(list(range(48))).scores == {9: 3}
+    idx.apply_event(9, {"kind": "removed", "block_hashes": h[1:]})
+    assert idx.find_matches(h).scores == {9: 1}
+    idx.apply_event(9, {"kind": "cleared"})
+    assert idx.find_matches(h).scores == {}
