@@ -51,6 +51,10 @@ class EngineArgs:
     seed: int = 0
     eos_token_ids: List[int] = field(default_factory=list)
     checkpoint_path: Optional[str] = None
+    # KVBM tiers (0 / None = disabled): host-DRAM and disk offload pools.
+    kvbm_host_blocks: int = 0
+    kvbm_disk_dir: Optional[str] = None
+    kvbm_disk_blocks: int = 0
 
 
 class TpuEngine:
@@ -99,6 +103,17 @@ class TpuEngine:
             ),
             kv_event_sink=kv_event_sink,
         )
+        if args.kvbm_host_blocks > 0:
+            from dynamo_tpu.llm.block_manager import KvBlockManager
+
+            engine.kvbm = KvBlockManager(
+                engine.scheduler.cache,
+                engine.scheduler.allocator,
+                host_blocks=args.kvbm_host_blocks,
+                disk_dir=args.kvbm_disk_dir,
+                disk_blocks=args.kvbm_disk_blocks,
+            )
+            engine.scheduler.attach_kvbm(engine.kvbm)
         return engine
 
     def _on_kv_event(self, ev: KvEvent) -> None:

@@ -95,6 +95,10 @@ class BlockAllocator:
         # LRU over cached (refcount-0, hashed) blocks.
         self._cached_lru: "OrderedDict[int, None]" = OrderedDict()
         self.on_event = on_event
+        # KVBM offload hook: called (block_id, block_hash) when a cached
+        # block is evicted for reuse — the copy-out point for the G1→G2
+        # cascade (content is still intact at call time).
+        self.on_evict: Optional[Callable[[int, int], None]] = None
 
     # --- queries ------------------------------------------------------------
     @property
@@ -139,6 +143,8 @@ class BlockAllocator:
                     h = self._hash_of.pop(bid)
                     del self._by_hash[h]
                     removed_hashes.append(h)
+                    if self.on_evict is not None:
+                        self.on_evict(bid, h)  # offload cascade copy-out
                 else:
                     raise OutOfBlocksError(f"need {n} blocks, {len(out)} available")
                 self._refcount[bid] = 1

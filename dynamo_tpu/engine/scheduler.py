@@ -165,6 +165,8 @@ class Scheduler:
         self.cache = KvCacheArrays.create(model_config, self.sc.num_blocks, dtype=dtype)
         self.max_blocks_per_seq = (model_config.max_seq_len + model_config.block_size - 1) // model_config.block_size
 
+        # Optional tiered block manager (KVBM) — set via attach_kvbm().
+        self.kvbm = None
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
         self.by_id: Dict[str, Sequence] = {}
@@ -283,7 +285,7 @@ class Scheduler:
             try:
                 if self.sc.enable_prefix_caching:
                     seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
-                    matched = self.allocator.match_prefix(seq.block_hashes)
+                    matched = self._match_prefix_tiers(seq)
                     # Keep at least one token to prefill so we always produce logits.
                     if matched and len(matched) * bs >= len(seq.prompt):
                         self.allocator.release([matched[-1]])
@@ -379,6 +381,17 @@ class Scheduler:
         return outputs
 
     # --- helpers ------------------------------------------------------------
+    def attach_kvbm(self, kvbm) -> None:
+        """Enable tiered offload/onboard (KVBM G2/G3) for this scheduler."""
+        self.kvbm = kvbm
+
+    def _match_prefix_tiers(self, seq: Sequence) -> List[int]:
+        """G1 match, extended through G2/G3 onboarding when KVBM is attached."""
+        if self.kvbm is None:
+            return self.allocator.match_prefix(seq.block_hashes)
+        match = self.kvbm.match_prefix(seq.block_hashes)
+        return self.kvbm.onboard(match, seq.block_hashes)
+
     def _block_table(self, seq: Sequence) -> jnp.ndarray:
         table = np.zeros((self.max_blocks_per_seq,), dtype=np.int32)
         table[: len(seq.block_ids)] = seq.block_ids

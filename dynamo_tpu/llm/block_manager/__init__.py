@@ -1,0 +1,172 @@
+"""KVBM: multi-tier KV block management (HBM → host DRAM → disk).
+
+Ref: lib/llm/src/block_manager (20k LoC) — ``KvBlockManager``
+(block_manager.rs:99), tiers ``CacheLevel::{G1,G2,G3,G4}`` (:62-75),
+offload cascade on registration/eviction (offload.rs), onboarding
+(``onboard_blocks`` :144), sequence-hash registry (block/registry.rs:478).
+
+TPU-native mapping:
+- **G1** — device HBM: the engine's paged ``KvCacheArrays`` + BlockAllocator.
+- **G2** — host DRAM: numpy block pool, filled by the offload cascade when G1
+  evicts a cached block (copy-out happens *before* reuse via the allocator's
+  eviction hook). The reference's ``block_copy.cu`` kernels become jitted XLA
+  gather/scatter + ``jax.device_get/put`` DMA (transfer.py).
+- **G3** — local disk: file-per-block spill from G2 eviction.
+- **G4** — remote pool (cross-host over the control plane object store);
+  round-2 scope, interface reserved.
+
+Lookup walks tiers: G1 hit ⇒ free; G2/G3 hit ⇒ *onboard* (copy back into
+freshly allocated G1 blocks) — still far cheaper than recomputing prefill
+(the reference reports +40% TTFT from host offload alone, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays
+from dynamo_tpu.llm.block_manager.storage import DiskPool, HostPool
+from dynamo_tpu.llm.block_manager.transfer import gather_blocks, scatter_blocks
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CacheLevel(enum.Enum):
+    G1 = "device"
+    G2 = "host"
+    G3 = "disk"
+    G4 = "remote"
+
+
+@dataclass
+class KvbmMetrics:
+    offloads_g2: int = 0
+    offloads_g3: int = 0
+    onboards_g2: int = 0
+    onboards_g3: int = 0
+    matched_tokens_g1: int = 0
+    matched_tokens_tiered: int = 0
+
+
+@dataclass
+class TieredMatch:
+    """Result of a tiered prefix lookup."""
+
+    g1_blocks: List[int] = field(default_factory=list)  # device blocks, ref-acquired
+    onboardable: List[Tuple[int, CacheLevel]] = field(default_factory=list)  # (hash, tier)
+
+    @property
+    def total_blocks(self) -> int:
+        return len(self.g1_blocks) + len(self.onboardable)
+
+
+class KvBlockManager:
+    """Owns the tier hierarchy around a device cache + allocator."""
+
+    def __init__(
+        self,
+        cache: KvCacheArrays,
+        allocator: BlockAllocator,
+        *,
+        host_blocks: int = 0,
+        disk_dir: Optional[str] = None,
+        disk_blocks: int = 0,
+    ):
+        self.cache = cache
+        self.allocator = allocator
+        self.host = HostPool(capacity=host_blocks) if host_blocks > 0 else None
+        self.disk = DiskPool(disk_dir, capacity=disk_blocks) if disk_dir and disk_blocks > 0 else None
+        self.metrics = KvbmMetrics()
+        # Offload-on-eviction: copy out before the device block is reused.
+        allocator.on_evict = self._offload_block
+
+    # --- offload cascade (G1 → G2 → G3) ------------------------------------
+    def _offload_block(self, block_id: int, block_hash: int) -> None:
+        if self.host is None:
+            return
+        if self.host.has(block_hash) or (self.disk is not None and self.disk.has(block_hash)):
+            return
+        k_np, v_np = gather_blocks(self.cache, block_id)
+        spilled = self.host.put(block_hash, k_np, v_np)
+        self.metrics.offloads_g2 += 1
+        if spilled is not None and self.disk is not None:
+            sh, sk, sv = spilled
+            if not self.disk.has(sh):
+                self.disk.put(sh, sk, sv)
+                self.metrics.offloads_g3 += 1
+
+    # --- tiered lookup ------------------------------------------------------
+    def match_prefix(self, block_hashes: Sequence[int]) -> TieredMatch:
+        """Longest-prefix match across tiers. G1 blocks come back
+        ref-acquired; deeper-tier hits come back as onboard candidates.
+        The chain must stay contiguous: a tier miss ends the walk."""
+        match = TieredMatch()
+        g1 = self.allocator.match_prefix(block_hashes)
+        match.g1_blocks = g1
+        self.metrics.matched_tokens_g1 += len(g1)
+        for h in block_hashes[len(g1) :]:
+            if self.host is not None and self.host.has(h):
+                match.onboardable.append((h, CacheLevel.G2))
+            elif self.disk is not None and self.disk.has(h):
+                match.onboardable.append((h, CacheLevel.G3))
+            else:
+                break
+        self.metrics.matched_tokens_tiered += len(match.onboardable)
+        return match
+
+    # --- onboarding (ref: onboard_blocks block_manager.rs:144) --------------
+    def onboard(self, match: TieredMatch, block_hashes: Sequence[int]) -> List[int]:
+        """Copy onboardable blocks into fresh G1 blocks; returns the full
+        ref-held device block list (g1 + onboarded). On allocation failure the
+        match degrades to its G1 prefix (caller prefills the rest)."""
+        if not match.onboardable:
+            return match.g1_blocks
+        try:
+            new_blocks = self.allocator.allocate(len(match.onboardable))
+        except Exception:
+            match.onboardable = []
+            return match.g1_blocks
+        for bid, (h, tier) in zip(new_blocks, match.onboardable):
+            if tier == CacheLevel.G2:
+                entry = self.host.get(h)
+                self.metrics.onboards_g2 += 1
+            else:
+                entry = self.disk.get(h)
+                self.metrics.onboards_g3 += 1
+            if entry is None:  # raced out of the pool — stop onboarding here
+                idx = new_blocks.index(bid)
+                self.allocator.release(new_blocks[idx:])
+                match.onboardable = match.onboardable[:idx]
+                return match.g1_blocks + new_blocks[:idx]
+            k_np, v_np = entry
+            scatter_blocks(self.cache, bid, k_np, v_np)
+        # Register the onboarded blocks under their hashes so future requests
+        # hit them in G1 directly.
+        n_g1 = len(match.g1_blocks)
+        hashes = list(block_hashes[n_g1 : n_g1 + len(new_blocks)])
+        self.allocator.register_hashes(new_blocks, hashes)
+        return match.g1_blocks + new_blocks
+
+    # --- introspection ------------------------------------------------------
+    def usage(self) -> Dict[str, float]:
+        out = {"g1": self.allocator.usage()}
+        if self.host is not None:
+            out["g2"] = self.host.usage()
+        if self.disk is not None:
+            out["g3"] = self.disk.usage()
+        return out
+
+    def reset_tier(self, level: CacheLevel) -> int:
+        """Ref: block_manager/controller.rs reset endpoints."""
+        if level == CacheLevel.G1:
+            return self.allocator.clear_cached()
+        if level == CacheLevel.G2 and self.host is not None:
+            return self.host.clear()
+        if level == CacheLevel.G3 and self.disk is not None:
+            return self.disk.clear()
+        return 0

@@ -1,0 +1,122 @@
+"""Tier storage backends: host DRAM pool + disk pool.
+
+Ref: lib/llm/src/block_manager/storage.rs (``Storage`` trait,
+``PinnedStorage``/``DiskStorage`` allocators) and pool/managed.rs (LRU
+inactive sets). Host blocks are plain numpy (the pinned-memory role — on TPU
+hosts, jax transfers from host numpy already use the fast path); disk blocks
+are one ``.npz`` per block hash (the reference's GDS file-per-layout role).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class HostPool:
+    """LRU pool of KV block pairs in host memory. ``put`` may spill the LRU
+    entry: it is returned to the caller for cascade to the next tier."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._data: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+
+    def has(self, block_hash: int) -> bool:
+        return block_hash in self._data
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        spilled = None
+        if block_hash in self._data:
+            self._data.move_to_end(block_hash)
+            return None
+        if len(self._data) >= self.capacity:
+            h, (sk, sv) = self._data.popitem(last=False)
+            spilled = (h, sk, sv)
+        self._data[block_hash] = (k, v)
+        return spilled
+
+    def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        entry = self._data.get(block_hash)
+        if entry is not None:
+            self._data.move_to_end(block_hash)
+        return entry
+
+    def usage(self) -> float:
+        return len(self._data) / max(self.capacity, 1)
+
+    def clear(self) -> int:
+        n = len(self._data)
+        self._data.clear()
+        return n
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class DiskPool:
+    """File-per-block spill tier (one .npz per block hash), LRU by mtime
+    order maintained in-memory."""
+
+    def __init__(self, directory: str, capacity: int):
+        self.directory = directory
+        self.capacity = capacity
+        os.makedirs(directory, exist_ok=True)
+        self._index: "OrderedDict[int, str]" = OrderedDict()
+        # Recover existing blocks (restart resume — ref: KVBM disk persistence
+        # as a resume mechanism, SURVEY.md §5 checkpoint/resume).
+        for fname in sorted(os.listdir(directory)):
+            if fname.endswith(".npz"):
+                try:
+                    self._index[int(fname[:-4], 16)] = os.path.join(directory, fname)
+                except ValueError:
+                    continue
+
+    def _path(self, block_hash: int) -> str:
+        return os.path.join(self.directory, f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}.npz")
+
+    def has(self, block_hash: int) -> bool:
+        return block_hash in self._index
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        if block_hash in self._index:
+            return
+        while len(self._index) >= self.capacity:
+            h, path = self._index.popitem(last=False)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        path = self._path(block_hash)
+        np.savez(path, k=k, v=v)
+        self._index[block_hash] = path
+
+    def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        path = self._index.get(block_hash)
+        if path is None:
+            return None
+        try:
+            with np.load(path) as z:
+                self._index.move_to_end(block_hash)
+                return z["k"], z["v"]
+        except (OSError, KeyError):
+            self._index.pop(block_hash, None)
+            return None
+
+    def usage(self) -> float:
+        return len(self._index) / max(self.capacity, 1)
+
+    def clear(self) -> int:
+        n = len(self._index)
+        for h, path in self._index.items():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._index.clear()
+        return n
+
+    def __len__(self) -> int:
+        return len(self._index)

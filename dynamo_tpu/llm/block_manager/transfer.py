@@ -1,0 +1,40 @@
+"""Device↔host block transfer: the ``block_copy.cu`` equivalent.
+
+Ref: lib/llm/src/kernels/block_copy.cu (758 LoC of vectorized strided copy
+kernels) + block/transfer/cuda.rs. On TPU the same job is a jitted XLA
+gather/scatter (XLA emits the optimal DMA) + ``jax.device_get/put`` across
+PCIe. Jitted once per cache shape; block id is a traced scalar so every block
+reuses the same executable.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+
+
+@jax.jit
+def _gather(k_cache: jax.Array, v_cache: jax.Array, block_id: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[L, N, BS, KVH, HD] → block [L, BS, KVH, HD]."""
+    return k_cache[:, block_id], v_cache[:, block_id]
+
+
+@jax.jit
+def _scatter(k_cache: jax.Array, v_cache: jax.Array, block_id: jax.Array, k: jax.Array, v: jax.Array):
+    return k_cache.at[:, block_id].set(k), v_cache.at[:, block_id].set(v)
+
+
+def gather_blocks(cache: KvCacheArrays, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Device block → host numpy (device_get performs the DMA)."""
+    k_dev, v_dev = _gather(cache.k, cache.v, jnp.int32(block_id))
+    return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
+
+
+def scatter_blocks(cache: KvCacheArrays, block_id: int, k: np.ndarray, v: np.ndarray) -> None:
+    """Host numpy → device block (in-place on the cache handle)."""
+    cache.k, cache.v = _scatter(cache.k, cache.v, jnp.int32(block_id), jnp.asarray(k), jnp.asarray(v))
