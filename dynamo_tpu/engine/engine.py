@@ -139,9 +139,9 @@ class TpuEngine:
                     self._wake.clear()
                     await self._wake.wait()
                     continue
-                for rid, tokens, sampling, stop, queue in self._staged_adds:
+                for rid, tokens, sampling, stop, queue, extras in self._staged_adds:
                     try:
-                        seq = self.scheduler.add_request(rid, tokens, sampling, stop)
+                        seq = self.scheduler.add_request(rid, tokens, sampling, stop, **extras)
                         seq.out_queue = queue
                     except ValueError as e:
                         queue.put_nowait(StepOutput(token_id=-1, finished=True, finish_reason=f"error:{e}"))
@@ -174,8 +174,15 @@ class TpuEngine:
             top_p=float(sampling_d.get("top_p") or 1.0),
         )
         stop = StopConditions.from_dict(request.get("stop_conditions"))
+        disagg = request.get("disagg_params") or {}
+        # keep_blocks: prefill role (decode worker will pull the KV);
+        # _prefilled: decode role (KV already pulled, injected locally).
+        extras = {
+            "keep_blocks_on_finish": bool(disagg.get("do_remote_decode")),
+            "prefilled": request.get("_prefilled"),
+        }
         queue: "asyncio.Queue[StepOutput]" = asyncio.Queue()
-        self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue))
+        self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue, extras))
         self._wake.set()
 
         finished = False
@@ -216,6 +223,12 @@ class TpuEngine:
     def abort(self, request_id: str) -> None:
         self._staged_aborts.append(request_id)
         self._wake.set()
+
+    # --- disaggregation -----------------------------------------------------
+    async def take_export(self, request_id: str):
+        """Pull a finished prefill-role request's KV blocks (device→host) and
+        release them. Returns (blocks, hashes, prompt_len) or None."""
+        return await asyncio.to_thread(self.scheduler.take_export, request_id)
 
     # --- introspection ------------------------------------------------------
     def metrics(self) -> ForwardPassMetrics:

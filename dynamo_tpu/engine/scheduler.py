@@ -101,6 +101,11 @@ class Sequence:
     first_token_ts: Optional[float] = None
     aborted: bool = False
     abort_reason: str = "cancelled"
+    # Disaggregation: prefill-role sequences keep their blocks at finish for
+    # export to the decode worker (ref: vllm do_remote_decode flow, §3C).
+    keep_blocks_on_finish: bool = False
+    # Decode-role sequences start from remotely prefilled KV.
+    prefilled: Optional[dict] = None
 
     @property
     def all_ids(self) -> List[int]:
@@ -167,6 +172,8 @@ class Scheduler:
 
         # Optional tiered block manager (KVBM) — set via attach_kvbm().
         self.kvbm = None
+        # Finished prefill-role sequences awaiting KV export (disagg).
+        self._pending_exports: Dict[str, Sequence] = {}
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
         self.by_id: Dict[str, Sequence] = {}
@@ -197,6 +204,9 @@ class Scheduler:
         token_ids: List[int],
         sampling: SamplingParams,
         stop: StopConditions,
+        *,
+        keep_blocks_on_finish: bool = False,
+        prefilled: Optional[dict] = None,
     ) -> Sequence:
         if not token_ids:
             raise ValueError("empty prompt")
@@ -208,6 +218,8 @@ class Scheduler:
             sampling=sampling,
             stop=stop,
             eos_token_ids=self._eos,
+            keep_blocks_on_finish=keep_blocks_on_finish,
+            prefilled=prefilled,
         )
         self.waiting.append(seq)
         self.by_id[request_id] = seq
@@ -278,6 +290,8 @@ class Scheduler:
         """Run one prefill chunk for ``seq``. Returns True when the prompt is
         fully computed (sequence moved to running)."""
         bs = self.mc.block_size
+        if seq.state == SeqState.WAITING and seq.prefilled is not None:
+            return self._inject_prefilled(seq, outputs)
         if seq.state == SeqState.WAITING:
             # First touch: prefix-cache match + full block allocation. Must be
             # all-or-nothing: a partial failure here re-runs next step, so any
@@ -380,6 +394,42 @@ class Scheduler:
             self._append_token(seq, int(sampled[i]), outputs)
         return outputs
 
+    # --- disaggregation support ---------------------------------------------
+    def _inject_prefilled(self, seq: Sequence, outputs: List[tuple]) -> bool:
+        """Decode-role admission: KV arrived from a prefill worker — scatter
+        it into fresh blocks and enter decode directly (no prefill compute)."""
+        from dynamo_tpu.llm.block_manager.transfer import scatter_blocks
+
+        bs = self.mc.block_size
+        data = seq.prefilled
+        n_blocks = (len(seq.prompt) + 1 + bs - 1) // bs
+        seq.block_ids = self.allocator.allocate(n_blocks)  # raises → retried next step
+        for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
+            scatter_blocks(self.cache, bid, k_np, v_np)
+        seq.num_computed = len(seq.prompt)
+        if self.sc.enable_prefix_caching:
+            seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
+            self._register_full_blocks(seq)
+        seq.state = SeqState.RUNNING
+        seq.first_token_ts = time.monotonic()
+        self.running.append(seq)
+        self._append_token(seq, int(data["first_token"]), outputs)
+        return True
+
+    def take_export(self, request_id: str):
+        """Prefill-role export: hand over the finished sequence's blocks
+        (k/v numpy per block) and release them. Returns (blocks, hashes,
+        prompt_len) or None."""
+        from dynamo_tpu.llm.block_manager.transfer import gather_blocks
+
+        seq = self._pending_exports.pop(request_id, None)
+        if seq is None:
+            return None
+        data = [gather_blocks(self.cache, bid) for bid in seq.block_ids]
+        self.allocator.release(seq.block_ids)
+        seq.block_ids = []
+        return data, seq.block_hashes, len(seq.prompt)
+
     # --- helpers ------------------------------------------------------------
     def attach_kvbm(self, kvbm) -> None:
         """Enable tiered offload/onboard (KVBM G2/G3) for this scheduler."""
@@ -465,8 +515,13 @@ class Scheduler:
             seq.block_hashes = extend_block_hashes(seq.block_hashes, seq.all_ids, bs)
             n_full = len(seq.all_ids) // bs
             self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
-        self.allocator.release(seq.block_ids)
-        seq.block_ids = []
+        if seq.keep_blocks_on_finish and reason != "cancelled":
+            # Disagg prefill role: hold blocks until the decode worker pulls
+            # them (take_export); refs stay live so eviction can't touch them.
+            self._pending_exports[seq.request_id] = seq
+        else:
+            self.allocator.release(seq.block_ids)
+            seq.block_ids = []
         if emit:
             outputs.append((seq, StepOutput(token_id=-1, finished=True, finish_reason=reason)))
         self.by_id.pop(seq.request_id, None)

@@ -294,6 +294,12 @@ class _PushEndpoint:
                     await call_home.error("request cancelled")
                 else:
                     await call_home.complete()
+            except ConnectionError:
+                # Engine/infrastructure death (the EngineDeadError class of
+                # failure): drop the socket without a final frame so the
+                # caller observes a genuine stream disconnect and the
+                # Migration operator can replay elsewhere.
+                logger.warning("engine connection failure for request %s; dropping stream", ctx.id)
             except Exception as e:
                 logger.exception("handler error for request %s", ctx.id)
                 try:

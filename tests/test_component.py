@@ -151,7 +151,7 @@ async def test_stream_disconnect_surfaces_for_migration():
         await client.wait_for_instances(1, timeout=5)
         router = PushRouter(client)
         got = []
-        with pytest.raises(RuntimeError):
+        with pytest.raises(StreamDisconnect):
             async for a in router.generate({}):
                 got.append(a.data)
         assert got == [{"i": 0}]

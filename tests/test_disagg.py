@@ -1,0 +1,161 @@
+"""Disaggregated prefill/decode tests: KV transfer correctness (disagg ≡
+aggregated, token-exact), conditional disagg, prefill-pool fallback.
+Ref: SURVEY.md §3C + tests/serve disagg coverage."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.disagg import (
+    DisaggDecodeHandler,
+    DisaggRouter,
+    DisaggRouterConf,
+    KvExportService,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+
+
+def build_engine():
+    # Same seed ⇒ identical weights across instances (random-init parity).
+    return TpuEngine.build(
+        EngineArgs(
+            model="tiny",
+            dtype="float32",
+            seed=7,
+            scheduler=SchedulerConfig(
+                num_blocks=64,
+                prefill_buckets=[16, 32, 64],
+                decode_buckets=[1, 2, 4, 8],
+                enable_prefix_caching=False,  # isolate the transfer path
+            ),
+        )
+    )
+
+
+def req(tokens, max_tokens=6):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": max_tokens},
+    }
+
+
+async def collect(engine_like, request, ctx=None):
+    out = []
+    fin = None
+    async for frame in engine_like.generate(request, ctx or Context()):
+        data = frame.data if hasattr(frame, "data") else frame
+        if data:
+            out.extend(data.get("token_ids") or [])
+            fin = data.get("finish_reason") or fin
+    return out, fin
+
+
+async def setup_disagg(drt, *, conf=None):
+    """Prefill worker + decode handler wired over the real wire path."""
+    prefill_engine = build_engine()
+    decode_engine = build_engine()
+
+    prefill_ep = drt.namespace("disagg").component("prefill").endpoint("generate")
+    handle = await prefill_ep.serve_endpoint(prefill_engine.generate, stats_handler=prefill_engine.stats_handler)
+    kvx = KvExportService(drt, prefill_engine, handle.instance)
+    await kvx.start()
+    drt.local_engines.pop(handle.instance.instance_id)  # force wire path
+
+    prefill_client = await prefill_ep.client()
+    await prefill_client.wait_for_instances(1, timeout=5)
+
+    disagg_router = None
+    if conf is not None:
+        disagg_router = DisaggRouter(drt, "tiny", conf=conf)
+    handler = DisaggDecodeHandler(drt, decode_engine, prefill_client, disagg_router)
+    return handler, prefill_engine, decode_engine, kvx, handle
+
+
+async def test_disagg_matches_aggregated():
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(drt)
+        prompt = list(range(20, 60))  # 40 tokens
+
+        # Aggregated reference on a third identical engine.
+        ref_engine = build_engine()
+        ref, _ = await collect(ref_engine, req(prompt))
+        await ref_engine.stop()
+
+        out, fin = await collect(handler, req(prompt))
+        assert out == ref, f"disagg {out} != aggregated {ref}"
+        assert fin == "length"
+        assert handler.remote_prefills == 1 and handler.local_prefills == 0
+        # Prefill worker's export was consumed: no leaked blocks.
+        assert prefill_engine.scheduler.allocator.num_active == 0
+        assert not prefill_engine.scheduler._pending_exports
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_conditional_disagg_short_prompt_local():
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(
+            drt, conf=DisaggRouterConf(max_local_prefill_length=100)
+        )
+        out, _ = await collect(handler, req(list(range(30))))  # 30 < 100 ⇒ local
+        assert handler.local_prefills == 1 and handler.remote_prefills == 0
+
+        out2, _ = await collect(handler, req(list(range(120))))  # 120 > 100 ⇒ remote
+        assert handler.remote_prefills == 1
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_prefill_pool_death_falls_back_to_local():
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(drt)
+        # Kill the prefill worker: its instance vanishes.
+        await handle.stop()
+        for _ in range(100):
+            if not handler.prefill_client.instances:
+                break
+            await asyncio.sleep(0.02)
+
+        out, fin = await collect(handler, req(list(range(40))))
+        assert len(out) == 6 and fin == "length"
+        assert handler.local_prefills == 1  # degraded gracefully
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_disagg_conf_hot_reload():
+    drt = await DistributedRuntime.detached()
+    try:
+        router = DisaggRouter(drt, "m1", conf=DisaggRouterConf(max_local_prefill_length=10))
+        await router.start()
+        assert router.prefill_remote(50, True)
+        assert not router.prefill_remote(5, True)
+        # Dynamic config update through the store (the etcd-watch role).
+        await drt.store.put(DisaggRouterConf.store_key("chat", "m1"), b'{"max_local_prefill_length": 1000}')
+        for _ in range(50):
+            if router.conf.max_local_prefill_length == 1000:
+                break
+            await asyncio.sleep(0.02)
+        assert not router.prefill_remote(50, True)
+        await router.stop()
+    finally:
+        await drt.shutdown()
