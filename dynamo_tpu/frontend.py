@@ -1,0 +1,66 @@
+"""OpenAI frontend CLI: ``python -m dynamo_tpu.frontend``.
+
+Ref: components/frontend/src/dynamo/frontend/main.py:81-286 — flags mirror
+the reference's CLI surface (router mode, kv knobs, busy threshold,
+migration limit, ports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.entrypoint import FrontendConfig, start_frontend
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger, init_logging
+
+logger = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8000)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--router-mode", choices=["round-robin", "random", "kv"], default="round-robin")
+    p.add_argument("--busy-threshold", type=float, default=None, help="kv-usage above which a worker is skipped")
+    p.add_argument("--migration-limit", type=int, default=0, help="max stream-drop replays per request")
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--kv-cache-block-size", type=int, default=16)
+    return p
+
+
+async def amain(args) -> None:
+    drt = await DistributedRuntime.from_settings()
+    drt.runtime.install_signal_handlers()
+    config = FrontendConfig(
+        host=args.http_host,
+        port=args.http_port,
+        router_mode=args.router_mode,
+        busy_threshold=args.busy_threshold,
+        migration_limit=args.migration_limit,
+        kv_overlap_score_weight=args.kv_overlap_score_weight,
+        kv_temperature=args.router_temperature,
+        namespace=args.namespace,
+    )
+    service = await start_frontend(drt, config)
+    logger.info("frontend ready on %s:%d (router=%s)", args.http_host, service.port, args.router_mode)
+    try:
+        await drt.runtime.cancellation.cancelled()
+    finally:
+        await service.watcher.stop()
+        await service.stop()
+        await drt.shutdown()
+
+
+def main() -> None:
+    init_logging()
+    try:
+        asyncio.run(amain(build_parser().parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

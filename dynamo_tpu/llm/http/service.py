@@ -67,6 +67,7 @@ class HttpService:
             "request_duration_seconds", "request duration", buckets=DURATION_BUCKETS, model=model
         )
         self._m_output_tokens = lambda model: m.counter("output_tokens_total", "output tokens", model=model)
+        self._m_input_tokens = lambda model: m.counter("input_tokens_total", "input (prompt) tokens", model=model)
 
     # --- lifecycle ----------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -163,10 +164,16 @@ class HttpService:
     async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
         text_parts = []
         n_tokens = 0
+        prompt_tokens = 0
         finish_reason = "stop"
         first_tok_at = None
         try:
             async for item in engine.generate(body, ctx):
+                if isinstance(item, Annotated) and item.is_annotation():
+                    if item.event == "_metrics":
+                        prompt_tokens = int(item.comment or 0)
+                        self._m_input_tokens(model).inc(prompt_tokens)
+                    continue
                 out = _as_output(item)
                 if out is None:
                     continue
@@ -184,7 +191,7 @@ class HttpService:
             return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
         self._m_requests(model, "200").inc()
         self._m_output_tokens(model).inc(n_tokens)
-        usage = oai.usage_dict(prompt_tokens=0, completion_tokens=n_tokens)
+        usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
         text = "".join(text_parts)
         if kind == "chat":
             return web.json_response(oai.chat_response(rid, model, text, finish_reason, usage))
@@ -209,6 +216,10 @@ class HttpService:
                 await _sse(resp, oai.chat_chunk(rid, model, {"role": "assistant", "content": ""}))
             async for item in engine.generate(body, ctx):
                 if isinstance(item, Annotated) and item.is_annotation():
+                    if item.event.startswith("_"):
+                        if item.event == "_metrics":
+                            self._m_input_tokens(model).inc(int(item.comment or 0))
+                        continue
                     await _sse_event(resp, item.event, item.comment)
                     continue
                 out = _as_output(item)

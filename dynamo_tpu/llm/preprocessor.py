@@ -88,6 +88,10 @@ class OpenAIPreprocessor(Operator):
         annotations = request.get("annotations") or []
 
         async def gen():
+            # Internal metrics annotation (consumed by the HTTP service for
+            # usage/ISL accounting; never emitted to clients — "_"-prefixed
+            # events are internal).
+            yield Annotated(event="_metrics", comment=str(len(request.get("token_ids") or [])))
             # Requested annotations are emitted before engine output
             # (ref: preprocessor.rs annotations path).
             if ANNOTATION_FORMATTED_PROMPT in annotations and request.get("_formatted_prompt") is not None:

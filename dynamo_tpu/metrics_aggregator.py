@@ -1,0 +1,90 @@
+"""Metrics aggregator service: scrape worker stats → Prometheus.
+
+Ref: components/metrics/src/{main.rs,lib.rs} (863 LoC Rust) — polls
+component service stats and exposes cluster-level Prometheus gauges (plus the
+KV-hit-rate event consumer). Run:
+``python -m dynamo_tpu.metrics_aggregator --endpoint ns/comp/ep``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer, HEALTHY
+from dynamo_tpu.runtime.logging import get_logger, init_logging
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+logger = get_logger(__name__)
+
+
+class MetricsAggregator:
+    def __init__(self, drt: DistributedRuntime, namespace: str, component: str, endpoint: str, interval_s: float = 2.0):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.endpoint_name = endpoint
+        self.interval_s = interval_s
+        self.registry = MetricsRegistry(labels={"namespace": namespace, "component": component})
+        self._task: Optional[asyncio.Task] = None
+        self.client = None
+
+    async def start(self) -> None:
+        ep = self.drt.namespace(self.namespace).component(self.component).endpoint(self.endpoint_name)
+        self.client = await ep.client()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        g_workers = self.registry.gauge("workers", "live worker instances")
+        try:
+            while True:
+                stats = await self.client.scrape_stats()
+                g_workers.set(len(stats))
+                for wid, s in stats.items():
+                    labels = {"worker": f"{wid:x}"}
+                    for key in ("kv_usage", "num_running", "num_waiting", "in_flight", "remote_prefills", "local_prefills"):
+                        if key in s:
+                            self.registry.gauge(f"worker_{key}", f"worker {key}", **labels).set(float(s[key]))
+                await asyncio.sleep(self.interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+async def amain(args) -> None:
+    drt = await DistributedRuntime.from_settings()
+    ns, comp, ep = args.endpoint.split("/")
+    agg = MetricsAggregator(drt, ns, comp, ep, interval_s=args.interval)
+    await agg.start()
+    health = SystemHealth()
+    health.set_system_ready()
+    server = SystemStatusServer(health, metrics=agg.registry)
+    server.config.port = args.port
+    await server.start()
+    logger.info("metrics aggregator serving :%d/metrics for %s", server.port, args.endpoint)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    init_logging()
+    p = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
+    p.add_argument("--endpoint", required=True, help="ns/component/endpoint to scrape")
+    p.add_argument("--port", type=int, default=9090)
+    p.add_argument("--interval", type=float, default=2.0)
+    try:
+        asyncio.run(amain(p.parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

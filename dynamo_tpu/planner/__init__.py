@@ -1,0 +1,18 @@
+"""SLA + load planner: autoscaling prefill/decode worker fleets.
+
+Ref: components/planner/src/dynamo/planner (SURVEY.md §3F) — observe
+frontend metrics each adjustment interval, predict load, invert profiling
+interpolators against TTFT/ITL SLAs, scale replicas through a connector
+(Kubernetes in production; virtual/local here for sim + tests).
+"""
+
+from dynamo_tpu.planner.load_predictor import (
+    ARIMAPredictor,
+    ConstantPredictor,
+    LoadPredictor,
+    SeasonalNaivePredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.interpolator import PrefillInterpolator, DecodeInterpolator
+from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, SlaTargets
+from dynamo_tpu.planner.connectors import LocalConnector, VirtualConnector

@@ -1,0 +1,106 @@
+"""Scaling connectors: how the planner actually changes replica counts.
+
+Ref: components/planner — ``KubernetesConnector`` (scales
+DynamoGraphDeployment CRDs) and ``VirtualConnector`` (simulation,
+virtual_connector.py). Here:
+
+- :class:`VirtualConnector` — records targets (planner unit tests / sims).
+- :class:`LocalConnector` — actually spawns/retires in-process workers via
+  factory coroutines (TPU-host single-node autoscaling; also how the
+  planner e2e test runs a real scaling loop without a cluster).
+- :class:`KubernetesConnector` — kubectl-based scale for k8s deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import shutil
+import subprocess
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Connector:
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        raise NotImplementedError
+
+    async def get_replicas(self, component: str) -> int:
+        raise NotImplementedError
+
+
+class VirtualConnector(Connector):
+    def __init__(self):
+        self.targets: Dict[str, int] = {}
+        self.history: List[tuple] = []
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        self.targets[component] = replicas
+        self.history.append((component, replicas))
+
+    async def get_replicas(self, component: str) -> int:
+        return self.targets.get(component, 0)
+
+
+class LocalConnector(Connector):
+    """Scales real in-process workers. ``factory(component) -> handle`` must
+    return an object with an async ``stop()`` (e.g. ServeHandle wrapper)."""
+
+    def __init__(self, factory: Callable[[str], Awaitable[object]]):
+        self.factory = factory
+        self.workers: Dict[str, List[object]] = {}
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        current = self.workers.setdefault(component, [])
+        while len(current) < replicas:
+            current.append(await self.factory(component))
+            logger.info("scaled up %s -> %d", component, len(current))
+        while len(current) > replicas:
+            worker = current.pop()
+            await worker.stop()
+            logger.info("scaled down %s -> %d", component, len(current))
+
+    async def get_replicas(self, component: str) -> int:
+        return len(self.workers.get(component, []))
+
+    async def shutdown(self) -> None:
+        for component in list(self.workers):
+            await self.set_replicas(component, 0)
+
+
+class KubernetesConnector(Connector):
+    """kubectl-scale connector (ref: kubernetes_connector.py → kube.py).
+    Requires kubectl in PATH and a deployment per component."""
+
+    def __init__(self, namespace: str = "default", deployment_fmt: str = "dynamo-{component}"):
+        if shutil.which("kubectl") is None:
+            raise RuntimeError("kubectl not found in PATH")
+        self.namespace = namespace
+        self.deployment_fmt = deployment_fmt
+
+    def _name(self, component: str) -> str:
+        return self.deployment_fmt.format(component=component)
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        cmd = [
+            "kubectl", "-n", self.namespace, "scale", f"deployment/{self._name(component)}",
+            f"--replicas={replicas}",
+        ]
+        proc = await asyncio.create_subprocess_exec(*cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        _, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl scale failed: {err.decode()}")
+
+    async def get_replicas(self, component: str) -> int:
+        cmd = [
+            "kubectl", "-n", self.namespace, "get", f"deployment/{self._name(component)}",
+            "-o", "jsonpath={.spec.replicas}",
+        ]
+        proc = await asyncio.create_subprocess_exec(*cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        out, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl get failed: {err.decode()}")
+        return int(out.decode().strip() or 0)

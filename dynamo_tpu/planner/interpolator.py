@@ -1,0 +1,88 @@
+"""Profiling interpolators: performance surfaces feeding the SLA planner.
+
+Ref: benchmarks/profiler/profile_sla.py + docs/benchmarks/
+pre_deployment_profiling.md:60-84 — offline profiling produces (a) TTFT vs
+ISL points per prefill config (quadratic fit) and (b) an ITL surface vs
+(active KV blocks, context length) per decode config; the planner inverts
+these against SLA targets to size fleets.
+
+Profiles load from npz (keys ``isl``, ``ttft_ms``, ``thpt_per_chip`` /
+``active_kv``, ``context_len``, ``itl_ms``, ``thpt_per_chip``) or from dict
+points recorded by ``dynamo_tpu.planner.profiler``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PrefillInterpolator:
+    """TTFT(isl) quadratic fit + throughput/chip lookup."""
+
+    def __init__(self, isl: Sequence[float], ttft_ms: Sequence[float], thpt_per_chip: Sequence[float]):
+        isl = np.asarray(isl, dtype=np.float64)
+        self._ttft_coef = np.polyfit(isl, np.asarray(ttft_ms, dtype=np.float64), deg=min(2, len(isl) - 1))
+        self._thpt_coef = np.polyfit(isl, np.asarray(thpt_per_chip, dtype=np.float64), deg=min(2, len(isl) - 1))
+        self._isl_range = (float(isl.min()), float(isl.max()))
+
+    @classmethod
+    def from_npz(cls, path: str) -> "PrefillInterpolator":
+        z = np.load(path)
+        return cls(z["isl"], z["ttft_ms"], z["thpt_per_chip"])
+
+    def ttft_ms(self, isl: float) -> float:
+        return float(np.polyval(self._ttft_coef, np.clip(isl, *self._isl_range)))
+
+    def throughput_per_chip(self, isl: float) -> float:
+        return max(1e-9, float(np.polyval(self._thpt_coef, np.clip(isl, *self._isl_range))))
+
+
+class DecodeInterpolator:
+    """ITL surface over (active_kv_usage, context_len) via inverse-distance
+    interpolation on profiled points; inverted to find the max
+    throughput/chip that still meets the ITL SLA (ref:
+    find_best_throughput_per_gpu)."""
+
+    def __init__(
+        self,
+        active_kv: Sequence[float],
+        context_len: Sequence[float],
+        itl_ms: Sequence[float],
+        thpt_per_chip: Sequence[float],
+    ):
+        self.pts = np.stack(
+            [np.asarray(active_kv, dtype=np.float64), np.asarray(context_len, dtype=np.float64)], axis=1
+        )
+        self.itl = np.asarray(itl_ms, dtype=np.float64)
+        self.thpt = np.asarray(thpt_per_chip, dtype=np.float64)
+        self._scale = self.pts.max(axis=0)
+        self._scale[self._scale == 0] = 1.0
+
+    @classmethod
+    def from_npz(cls, path: str) -> "DecodeInterpolator":
+        z = np.load(path)
+        return cls(z["active_kv"], z["context_len"], z["itl_ms"], z["thpt_per_chip"])
+
+    def _idw(self, values: np.ndarray, active_kv: float, context_len: float) -> float:
+        q = np.array([active_kv, context_len], dtype=np.float64) / self._scale
+        d = np.linalg.norm(self.pts / self._scale - q, axis=1)
+        if d.min() < 1e-12:
+            return float(values[d.argmin()])
+        w = 1.0 / (d**2)
+        return float((values * w).sum() / w.sum())
+
+    def itl_ms(self, active_kv: float, context_len: float) -> float:
+        return self._idw(self.itl, active_kv, context_len)
+
+    def find_best_throughput_per_chip(self, itl_sla_ms: float, context_len: float) -> float:
+        """Max profiled throughput whose interpolated ITL meets the SLA at
+        this context length (binary search over the kv-usage axis)."""
+        best = 0.0
+        for kv, thpt in sorted(zip(self.pts[:, 0], self.thpt)):
+            if self.itl_ms(kv, context_len) <= itl_sla_ms:
+                best = max(best, float(thpt))
+        if best == 0.0:
+            best = float(self.thpt.min())  # SLA unattainable: size by the floor
+        return best

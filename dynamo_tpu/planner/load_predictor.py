@@ -1,0 +1,81 @@
+"""Load predictors for the SLA planner.
+
+Ref: components/planner/src/dynamo/planner/utils/load_predictor.py:66-158 —
+constant / ARIMA / Prophet. Prophet isn't in this image; a seasonal-naive
+predictor covers the periodic-traffic case it served.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+
+class LoadPredictor:
+    def __init__(self, window: int = 64):
+        self.history: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self.history.append(float(value))
+
+    def predict(self) -> float:
+        raise NotImplementedError
+
+
+class ConstantPredictor(LoadPredictor):
+    """Next load = last observed (ref: constant predictor)."""
+
+    def predict(self) -> float:
+        return self.history[-1] if self.history else 0.0
+
+
+class ARIMAPredictor(LoadPredictor):
+    """AR(p) via least squares on the differenced series — the workhorse of
+    the reference's ARIMA mode without statsmodels."""
+
+    def __init__(self, window: int = 64, order: int = 4):
+        super().__init__(window)
+        self.order = order
+
+    def predict(self) -> float:
+        h = np.asarray(self.history, dtype=np.float64)
+        if len(h) < self.order + 2:
+            return h[-1] if len(h) else 0.0
+        d = np.diff(h)
+        p = self.order
+        X = np.stack([d[i : len(d) - p + i] for i in range(p)], axis=1)
+        y = d[p:]
+        try:
+            coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+            next_diff = float(np.dot(d[-p:], coef))
+        except np.linalg.LinAlgError:
+            next_diff = 0.0
+        return max(0.0, h[-1] + next_diff)
+
+
+class SeasonalNaivePredictor(LoadPredictor):
+    """Next load = value one period ago (periodic traffic; the Prophet
+    role for daily/hourly sine-like load)."""
+
+    def __init__(self, window: int = 256, period: int = 24):
+        super().__init__(window)
+        self.period = period
+
+    def predict(self) -> float:
+        if len(self.history) >= self.period:
+            return self.history[-self.period]
+        return self.history[-1] if self.history else 0.0
+
+
+def make_predictor(kind: str, **kwargs) -> LoadPredictor:
+    kinds = {
+        "constant": ConstantPredictor,
+        "arima": ARIMAPredictor,
+        "seasonal": SeasonalNaivePredictor,
+        "prophet": SeasonalNaivePredictor,  # alias: closest available model
+    }
+    if kind not in kinds:
+        raise ValueError(f"unknown predictor {kind!r} (have {sorted(kinds)})")
+    return kinds[kind](**kwargs)

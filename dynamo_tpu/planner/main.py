@@ -1,0 +1,62 @@
+"""Planner CLI: ``python -m dynamo_tpu.planner.main`` (ref:
+``python -m dynamo.planner`` — start_sla_planner planner_core.py:552)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.planner.connectors import KubernetesConnector, VirtualConnector
+from dynamo_tpu.planner.interpolator import DecodeInterpolator, PrefillInterpolator
+from dynamo_tpu.planner.observer import PrometheusObserver
+from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, SlaTargets
+from dynamo_tpu.runtime.logging import get_logger, init_logging
+
+logger = get_logger(__name__)
+
+
+def main() -> None:
+    init_logging()
+    p = argparse.ArgumentParser(description="dynamo-tpu SLA planner")
+    p.add_argument("--frontend-metrics-url", default="http://127.0.0.1:8000/metrics")
+    p.add_argument("--prefill-profile", required=True, help="npz from dynamo_tpu.planner.profiler")
+    p.add_argument("--decode-profile", required=True)
+    p.add_argument("--adjustment-interval", type=float, default=30.0)
+    p.add_argument("--ttft-sla-ms", type=float, default=200.0)
+    p.add_argument("--itl-sla-ms", type=float, default=20.0)
+    p.add_argument("--max-chip-budget", type=int, default=8)
+    p.add_argument("--load-predictor", choices=["constant", "arima", "seasonal", "prophet"], default="arima")
+    p.add_argument("--connector", choices=["virtual", "kubernetes"], default="virtual")
+    p.add_argument("--k8s-namespace", default="default")
+    args = p.parse_args()
+
+    config = PlannerConfig(
+        adjustment_interval_s=args.adjustment_interval,
+        load_predictor=args.load_predictor,
+        max_chip_budget=args.max_chip_budget,
+        sla=SlaTargets(ttft_ms=args.ttft_sla_ms, itl_ms=args.itl_sla_ms),
+    )
+    connector = (
+        KubernetesConnector(namespace=args.k8s_namespace) if args.connector == "kubernetes" else VirtualConnector()
+    )
+    observer = PrometheusObserver(args.frontend_metrics_url)
+    planner = Planner(
+        config,
+        connector,
+        PrefillInterpolator.from_npz(args.prefill_profile),
+        DecodeInterpolator.from_npz(args.decode_profile),
+        observer.observe,
+    )
+
+    async def run():
+        logger.info("planner started: interval=%.0fs sla=%s", config.adjustment_interval_s, config.sla)
+        await planner.run()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

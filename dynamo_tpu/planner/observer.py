@@ -1,0 +1,65 @@
+"""Frontend metrics observation for the planner.
+
+Ref: planner_core.py ``observe_metrics`` (:193) — reads the frontend's
+Prometheus endpoint and derives per-interval request rate, average ISL, and
+average OSL from counter deltas.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, Optional
+
+import aiohttp
+
+from dynamo_tpu.planner.planner_core import ObservedLoad
+
+_METRIC_RE = re.compile(r"^(\w+)(?:\{([^}]*)\})?\s+([0-9.eE+-]+)$")
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Sum metric families across label sets (model-agnostic totals)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _METRIC_RE.match(line.strip())
+        if m:
+            name, _, value = m.groups()
+            out[name] = out.get(name, 0.0) + float(value)
+    return out
+
+
+class PrometheusObserver:
+    """Polls the frontend /metrics and yields ObservedLoad deltas."""
+
+    def __init__(self, metrics_url: str):
+        self.metrics_url = metrics_url
+        self._last: Optional[Dict[str, float]] = None
+        self._last_ts: Optional[float] = None
+
+    async def observe(self) -> ObservedLoad:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(self.metrics_url) as resp:
+                text = await resp.text()
+        now = time.monotonic()
+        cur = parse_prometheus(text)
+        load = ObservedLoad()
+        if self._last is not None and self._last_ts is not None:
+            dt = max(now - self._last_ts, 1e-6)
+
+            def delta(name: str) -> float:
+                return max(0.0, cur.get(name, 0.0) - self._last.get(name, 0.0))
+
+            d_req = delta("dynamo_frontend_requests_total")
+            d_in = delta("dynamo_frontend_input_tokens_total")
+            d_out = delta("dynamo_frontend_output_tokens_total")
+            load = ObservedLoad(
+                request_rate=d_req / dt,
+                avg_isl=d_in / d_req if d_req > 0 else 0.0,
+                avg_osl=d_out / d_req if d_req > 0 else 0.0,
+            )
+        self._last = cur
+        self._last_ts = now
+        return load

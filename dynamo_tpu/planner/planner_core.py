@@ -1,0 +1,156 @@
+"""The SLA planner adjustment loop.
+
+Ref: components/planner/src/dynamo/planner/utils/planner_core.py —
+``start_sla_planner`` (:552), ``Planner.run`` (:414): every
+``adjustment_interval``: observe frontend metrics (:193), predict load
+(:240), ``_compute_replica_requirements`` (:259):
+
+  prefill_replicas = ceil(req_rate * isl / interval / prefill_thpt_per_chip
+                          / chips_per_prefill_engine)
+  decode_replicas  = ceil(req_rate * osl / interval /
+                          itl_sla_inverted_thpt / chips_per_decode_engine)
+  clamp to max_chip_budget (:339-352)
+
+then ``make_adjustments`` (:355) through a connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from dynamo_tpu.planner.connectors import Connector
+from dynamo_tpu.planner.interpolator import DecodeInterpolator, PrefillInterpolator
+from dynamo_tpu.planner.load_predictor import LoadPredictor, make_predictor
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+PREFILL_COMPONENT = "prefill"
+DECODE_COMPONENT = "decode"
+
+
+@dataclass
+class SlaTargets:
+    ttft_ms: float = 200.0
+    itl_ms: float = 20.0
+
+
+@dataclass
+class ObservedLoad:
+    """One observation window from the frontend metrics
+    (ref: observe_metrics planner_core.py:193)."""
+
+    request_rate: float = 0.0  # req/s
+    avg_isl: float = 0.0  # input tokens per request
+    avg_osl: float = 0.0  # output tokens per request
+
+
+@dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 30.0
+    load_predictor: str = "arima"
+    chips_per_prefill_engine: int = 1
+    chips_per_decode_engine: int = 1
+    min_prefill_replicas: int = 1
+    min_decode_replicas: int = 1
+    max_chip_budget: int = 8
+    sla: SlaTargets = field(default_factory=SlaTargets)
+
+
+@dataclass
+class ReplicaPlan:
+    prefill: int
+    decode: int
+
+
+class Planner:
+    def __init__(
+        self,
+        config: PlannerConfig,
+        connector: Connector,
+        prefill_interp: PrefillInterpolator,
+        decode_interp: DecodeInterpolator,
+        observe_fn: Callable[[], Awaitable[ObservedLoad]],
+    ):
+        self.config = config
+        self.connector = connector
+        self.prefill_interp = prefill_interp
+        self.decode_interp = decode_interp
+        self.observe_fn = observe_fn
+        self.rate_predictor: LoadPredictor = make_predictor(config.load_predictor)
+        self.isl_predictor: LoadPredictor = make_predictor("constant")
+        self.osl_predictor: LoadPredictor = make_predictor("constant")
+        self._task: Optional[asyncio.Task] = None
+        self.last_plan: Optional[ReplicaPlan] = None
+
+    # --- the math (ref: _compute_replica_requirements :259) -----------------
+    def compute_replicas(self, load: ObservedLoad) -> ReplicaPlan:
+        c = self.config
+        isl = max(load.avg_isl, 1.0)
+        osl = max(load.avg_osl, 1.0)
+        rate = max(load.request_rate, 0.0)
+
+        # Prefill: token demand / per-chip prefill throughput at this ISL.
+        prefill_thpt = self.prefill_interp.throughput_per_chip(isl)
+        prefill_chips = rate * isl / prefill_thpt
+        prefill = max(c.min_prefill_replicas, math.ceil(prefill_chips / c.chips_per_prefill_engine))
+
+        # Decode: invert the ITL SLA into a max safe per-chip token rate.
+        decode_thpt = self.decode_interp.find_best_throughput_per_chip(c.sla.itl_ms, isl + osl)
+        decode_chips = rate * osl / max(decode_thpt, 1e-9)
+        decode = max(c.min_decode_replicas, math.ceil(decode_chips / c.chips_per_decode_engine))
+
+        # Budget clamp, preserving the prefill:decode ratio (ref :339-352).
+        total_chips = prefill * c.chips_per_prefill_engine + decode * c.chips_per_decode_engine
+        if total_chips > c.max_chip_budget:
+            scale = c.max_chip_budget / total_chips
+            prefill = max(c.min_prefill_replicas, math.floor(prefill * scale))
+            decode = max(c.min_decode_replicas, math.floor(decode * scale))
+        return ReplicaPlan(prefill=prefill, decode=decode)
+
+    # --- loop (ref: Planner.run :414) ---------------------------------------
+    async def step(self) -> ReplicaPlan:
+        load = await self.observe_fn()
+        self.rate_predictor.observe(load.request_rate)
+        self.isl_predictor.observe(load.avg_isl)
+        self.osl_predictor.observe(load.avg_osl)
+        predicted = ObservedLoad(
+            request_rate=self.rate_predictor.predict(),
+            avg_isl=self.isl_predictor.predict(),
+            avg_osl=self.osl_predictor.predict(),
+        )
+        plan = self.compute_replicas(predicted)
+        if self.last_plan is None or plan != self.last_plan:
+            logger.info(
+                "planner: rate=%.2f isl=%.0f osl=%.0f -> prefill=%d decode=%d",
+                predicted.request_rate, predicted.avg_isl, predicted.avg_osl, plan.prefill, plan.decode,
+            )
+            await self.connector.set_replicas(PREFILL_COMPONENT, plan.prefill)
+            await self.connector.set_replicas(DECODE_COMPONENT, plan.decode)
+            self.last_plan = plan
+        return plan
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("planner step failed")
+            await asyncio.sleep(self.config.adjustment_interval_s)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

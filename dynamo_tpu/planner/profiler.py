@@ -1,0 +1,107 @@
+"""Pre-deployment profiling: measure the engine's TTFT/ITL surfaces and save
+interpolator inputs.
+
+Ref: benchmarks/profiler/profile_sla.py — sweeps engine configs offline and
+writes npz files the SLA planner loads (pre_deployment_profiling.md:60-84).
+Run: ``python -m dynamo_tpu.planner.profiler --model tiny --out profiles/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import List
+
+import numpy as np
+
+
+def profile_prefill(model: str, isls: List[int], dtype: str = "bfloat16") -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.kv_cache import KvCacheArrays
+    from dynamo_tpu.engine.models import llama
+
+    cfg = get_config(model)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    rows = {"isl": [], "ttft_ms": [], "thpt_per_chip": []}
+    for isl in isls:
+        isl = min(isl, cfg.max_seq_len - cfg.block_size)
+        num_blocks = isl // cfg.block_size + 4
+        cache = KvCacheArrays.create(cfg, num_blocks=num_blocks + 1)
+        table = jnp.arange(1, num_blocks + 1, dtype=jnp.int32)
+        tokens = jnp.zeros((isl,), dtype=jnp.int32)
+
+        fn = jax.jit(lambda p, k, v, t: llama.prefill(p, cfg, k, v, t, jnp.int32(isl), jnp.int32(0), table))
+        logits, k, v = fn(params, cache.k, cache.v, tokens)  # compile
+        logits.block_until_ready()
+        t0 = time.perf_counter()
+        n = 3
+        for _ in range(n):
+            logits, k, v = fn(params, k, v, tokens)
+        logits.block_until_ready()
+        dt = (time.perf_counter() - t0) / n
+        rows["isl"].append(isl)
+        rows["ttft_ms"].append(dt * 1000)
+        rows["thpt_per_chip"].append(isl / dt)
+    return rows
+
+
+def profile_decode(model: str, batches: List[int], ctx: int = 1024, dtype: str = "bfloat16") -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.kv_cache import KvCacheArrays
+    from dynamo_tpu.engine.models import llama
+
+    cfg = get_config(model)
+    ctx = min(ctx, cfg.max_seq_len - cfg.block_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    rows = {"active_kv": [], "context_len": [], "itl_ms": [], "thpt_per_chip": []}
+    for B in batches:
+        blocks_per_seq = ctx // cfg.block_size + 2
+        num_blocks = B * blocks_per_seq + 1
+        cache = KvCacheArrays.create(cfg, num_blocks=num_blocks)
+        tables = jnp.stack(
+            [jnp.arange(1 + i * blocks_per_seq, 1 + (i + 1) * blocks_per_seq, dtype=jnp.int32) for i in range(B)]
+        )
+        toks = jnp.zeros((B,), dtype=jnp.int32)
+        pos = jnp.full((B,), ctx, dtype=jnp.int32)
+        active = jnp.ones((B,), dtype=bool)
+        fn = jax.jit(lambda p, k, v, t: llama.decode(p, cfg, k, v, t, pos, tables, active), donate_argnums=(1, 2))
+        logits, k, v = fn(params, cache.k, cache.v, toks)
+        logits.block_until_ready()
+        t0 = time.perf_counter()
+        n = 8
+        for _ in range(n):
+            logits, k, v = fn(params, k, v, toks)
+        logits.block_until_ready()
+        dt = (time.perf_counter() - t0) / n
+        rows["active_kv"].append(B * blocks_per_seq)
+        rows["context_len"].append(ctx)
+        rows["itl_ms"].append(dt * 1000)
+        rows["thpt_per_chip"].append(B / dt)
+    return rows
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu SLA profiler")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--out", default="profiles")
+    p.add_argument("--isls", type=int, nargs="+", default=[128, 256, 512, 1024])
+    p.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--ctx", type=int, default=1024)
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    pre = profile_prefill(args.model, args.isls)
+    np.savez(os.path.join(args.out, f"prefill_{args.model}.npz"), **{k: np.asarray(v) for k, v in pre.items()})
+    dec = profile_decode(args.model, args.batches, args.ctx)
+    np.savez(os.path.join(args.out, f"decode_{args.model}.npz"), **{k: np.asarray(v) for k, v in dec.items()})
+    print(f"profiles written to {args.out}/: prefill {pre['ttft_ms']} ms, decode {dec['itl_ms']} ms")
+
+
+if __name__ == "__main__":
+    main()

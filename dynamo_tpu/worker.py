@@ -1,0 +1,146 @@
+"""Worker CLI: serve a JAX engine (or mocker) as a discoverable endpoint.
+
+Ref: components/backends/{vllm,mocker}/main.py — roles: ``aggregated``
+(default), ``decode`` (forwards long prefills to the prefill pool),
+``prefill`` (serves remote prefills + KV export). The reference wraps
+external engines; here the engine is native (dynamo_tpu.engine) or the
+mocker.
+
+Run: ``python -m dynamo_tpu.worker --model tiny [--role decode|prefill]
+[--mocker]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.disagg import DisaggDecodeHandler, DisaggRouter, DisaggRouterConf, KvExportService
+from dynamo_tpu.llm.entrypoint import register_llm
+from dynamo_tpu.llm.kv_router import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger, init_logging
+
+logger = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="dynamo-tpu worker")
+    p.add_argument("--model", default="tiny", help="model preset name or local checkpoint dir")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default=None, help="defaults to role name")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--role", choices=["aggregated", "decode", "prefill"], default="aggregated")
+    p.add_argument("--mocker", action="store_true", help="serve the mocker engine instead of the JAX engine")
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--max-running", type=int, default=16)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--kvbm-host-blocks", type=int, default=0)
+    p.add_argument("--kvbm-disk-dir", default=None)
+    p.add_argument("--kvbm-disk-blocks", type=int, default=0)
+    p.add_argument("--max-local-prefill-length", type=int, default=0)
+    p.add_argument("--speedup-ratio", type=float, default=1.0, help="mocker time compression")
+    return p
+
+
+async def amain(args) -> None:
+    drt = await DistributedRuntime.from_settings()
+    drt.runtime.install_signal_handlers()
+
+    if args.mocker:
+        engine = MockTpuEngine(
+            MockEngineArgs(num_blocks=args.num_blocks, block_size=args.block_size, speedup_ratio=args.speedup_ratio)
+        )
+    else:
+        engine = TpuEngine.build(
+            EngineArgs(
+                model=args.model,
+                dtype=args.dtype,
+                checkpoint_path=args.checkpoint,
+                kvbm_host_blocks=args.kvbm_host_blocks,
+                kvbm_disk_dir=args.kvbm_disk_dir,
+                kvbm_disk_blocks=args.kvbm_disk_blocks,
+                scheduler=SchedulerConfig(num_blocks=args.num_blocks, max_running=args.max_running),
+            )
+        )
+
+    component = args.component or ("backend" if args.role == "aggregated" else args.role)
+    ep = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)
+
+    handler = engine
+    disagg_router = None
+    prefill_client = None
+    if args.role == "decode":
+        prefill_ep = drt.namespace(args.namespace).component("prefill").endpoint(args.endpoint)
+        prefill_client = await prefill_ep.client()
+        disagg_router = DisaggRouter(
+            drt, args.served_model_name or args.model,
+            conf=DisaggRouterConf(max_local_prefill_length=args.max_local_prefill_length),
+        )
+        await disagg_router.start()
+        handler = DisaggDecodeHandler(drt, engine, prefill_client, disagg_router)
+
+    card = ModelDeploymentCard(
+        name=args.served_model_name or args.model,
+        model_type="chat",
+        tokenizer_path=args.tokenizer,
+        kv_cache_block_size=args.block_size,
+    )
+    stats = handler.stats_handler if hasattr(handler, "stats_handler") else None
+    if args.role == "prefill":
+        # Prefill workers serve the internal pool, not public model discovery.
+        handle = await ep.serve_endpoint(engine.generate, stats_handler=stats)
+    else:
+        handle, _ = await register_llm(drt, ep, handler, card, stats_handler=stats)
+
+    worker_id = handle.instance.instance_id
+    kv_pub = KvEventPublisher(drt, args.namespace, component, worker_id)
+    kv_pub.start()
+    loop = asyncio.get_running_loop()
+    if args.mocker:
+        engine.set_kv_event_sink(kv_pub.publish)
+    else:
+        # Engine KV events fire on the scheduler thread — hop to the loop.
+        engine._kv_event_sink = lambda ev: kv_pub.publish_threadsafe(loop, ev)
+    m_pub = WorkerMetricsPublisher(drt, args.namespace, component, worker_id, engine.metrics)
+    m_pub.start()
+    publishers = [kv_pub, m_pub]
+
+    kvx = None
+    if args.role == "prefill":
+        kvx = KvExportService(drt, engine, handle.instance)
+        await kvx.start()
+
+    logger.info("worker ready: role=%s model=%s instance=%x", args.role, card.name, worker_id)
+    try:
+        await drt.runtime.cancellation.cancelled()
+    finally:
+        for pub in publishers:
+            await pub.stop()
+        if kvx is not None:
+            await kvx.stop()
+        if disagg_router is not None:
+            await disagg_router.stop()
+        if hasattr(engine, "stop"):
+            await engine.stop()
+        await drt.shutdown()
+
+
+def main() -> None:
+    init_logging()
+    try:
+        asyncio.run(amain(build_parser().parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Full-stack CLI e2e: control-plane broker + worker CLI + frontend CLI as
+separate OS processes, driven over HTTP — the closest equivalent of the
+reference's serve tests (tests/serve/) on one host."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.transports.tcp_control import ControlPlaneServer
+
+
+def spawn(args, port):
+    env = dict(
+        os.environ,
+        DYN_CONTROL_PLANE="tcp",
+        DYN_CONTROL_PLANE_ADDRESS=f"127.0.0.1:{port}",
+        JAX_PLATFORMS="cpu",
+        DYN_LOG="WARNING",
+    )
+    return subprocess.Popen(
+        [sys.executable, "-m"] + args,
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.e2e
+async def test_worker_frontend_cli_roundtrip():
+    server = ControlPlaneServer(host="127.0.0.1", port=0)
+    await server.start()
+    procs = []
+    try:
+        procs.append(
+            spawn(
+                ["dynamo_tpu.worker", "--mocker", "--model", "mock-model", "--speedup-ratio", "50"],
+                server.port,
+            )
+        )
+        http_port = 18231
+        procs.append(spawn(["dynamo_tpu.frontend", "--http-port", str(http_port), "--router-mode", "kv"], server.port))
+
+        base = f"http://127.0.0.1:{http_port}"
+        async with aiohttp.ClientSession() as s:
+            # Wait for the model to appear through discovery.
+            for _ in range(120):
+                try:
+                    async with s.get(f"{base}/v1/models") as r:
+                        if r.status == 200 and (await r.json())["data"]:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.25)
+            else:
+                pytest.fail("model never appeared via frontend discovery")
+
+            body = {
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hello from the cli e2e"}],
+                "max_tokens": 5,
+                "stream": True,
+            }
+            chunks = []
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+            finishes = [c["choices"][0].get("finish_reason") for c in chunks]
+            assert "length" in finishes
+
+            # Frontend metrics exposed.
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+                assert "dynamo_frontend_requests_total" in text
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+        await server.close()

@@ -1,0 +1,151 @@
+"""Planner tests: replica math against profiles (ref:
+tests/planner/test_replica_calculation.py), predictors, and a real scaling
+e2e with the LocalConnector spawning mocker workers (ref:
+test_scaling_e2e.py with VirtualConnector simulation)."""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ARIMAPredictor,
+    ConstantPredictor,
+    DecodeInterpolator,
+    LocalConnector,
+    Planner,
+    PlannerConfig,
+    PrefillInterpolator,
+    SeasonalNaivePredictor,
+    SlaTargets,
+    VirtualConnector,
+)
+from dynamo_tpu.planner.observer import parse_prometheus
+from dynamo_tpu.planner.planner_core import ObservedLoad
+
+
+def make_interps():
+    # Synthetic but realistic profile: TTFT grows ~quadratically with ISL;
+    # ITL grows with active KV; throughput degrades as ITL grows.
+    prefill = PrefillInterpolator(
+        isl=[128, 512, 1024, 4096],
+        ttft_ms=[20, 60, 130, 700],
+        thpt_per_chip=[8000, 10000, 11000, 9000],
+    )
+    decode = DecodeInterpolator(
+        active_kv=[8, 32, 128, 512],
+        context_len=[1024, 1024, 1024, 1024],
+        itl_ms=[5, 8, 15, 40],
+        thpt_per_chip=[50, 180, 600, 1200],
+    )
+    return prefill, decode
+
+
+def test_replica_math_scales_with_rate():
+    prefill, decode = make_interps()
+    cfg = PlannerConfig(max_chip_budget=64, sla=SlaTargets(itl_ms=16.0))
+    planner = Planner(cfg, VirtualConnector(), prefill, decode, observe_fn=None)
+
+    low = planner.compute_replicas(ObservedLoad(request_rate=1.0, avg_isl=1024, avg_osl=128))
+    high = planner.compute_replicas(ObservedLoad(request_rate=20.0, avg_isl=1024, avg_osl=128))
+    assert high.prefill >= low.prefill
+    assert high.decode >= low.decode
+    assert high.prefill > 1  # 20 req/s * 1024 isl needs real prefill capacity
+
+    # ITL SLA inversion: decode throughput cap excludes points violating SLA.
+    thpt = decode.find_best_throughput_per_chip(16.0, 1024)
+    assert thpt == 600  # the 40ms point (1200 thpt) violates the 16ms SLA
+
+
+def test_budget_clamp():
+    prefill, decode = make_interps()
+    cfg = PlannerConfig(max_chip_budget=4)
+    planner = Planner(cfg, VirtualConnector(), prefill, decode, observe_fn=None)
+    plan = planner.compute_replicas(ObservedLoad(request_rate=1000.0, avg_isl=4096, avg_osl=512))
+    assert plan.prefill + plan.decode <= 4 + 1  # floor() rounding tolerance
+
+
+def test_predictors():
+    c = ConstantPredictor()
+    for v in [1, 2, 3]:
+        c.observe(v)
+    assert c.predict() == 3
+
+    a = ARIMAPredictor(order=2)
+    for v in range(20):  # linear ramp
+        a.observe(float(v))
+    assert 19.5 <= a.predict() <= 21.5  # extrapolates the trend
+
+    s = SeasonalNaivePredictor(period=4)
+    for v in [1, 2, 3, 4] * 3:
+        s.observe(float(v))
+    assert s.predict() == 1.0  # one period back
+
+
+def test_parse_prometheus():
+    text = """# HELP x
+dynamo_frontend_requests_total{model="m",status="200"} 5
+dynamo_frontend_requests_total{model="m",status="400"} 2
+dynamo_frontend_output_tokens_total{model="m"} 130
+"""
+    out = parse_prometheus(text)
+    assert out["dynamo_frontend_requests_total"] == 7
+    assert out["dynamo_frontend_output_tokens_total"] == 130
+
+
+async def test_planner_scaling_e2e_with_local_connector():
+    """The planner drives a LocalConnector that spawns/retires real mocker
+    workers registered in a live DistributedRuntime."""
+    from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("plan").component("decode").endpoint("generate")
+        prefill_ep = drt.namespace("plan").component("prefill").endpoint("generate")
+
+        async def factory(component):
+            engine = MockTpuEngine(MockEngineArgs(speedup_ratio=100.0))
+            target = ep if component == "decode" else prefill_ep
+            handle = await target.serve_endpoint(engine.generate, stats_handler=engine.stats_handler)
+            return handle
+
+        connector = LocalConnector(factory)
+        prefill, decode = make_interps()
+        cfg = PlannerConfig(max_chip_budget=8, min_prefill_replicas=1, min_decode_replicas=1)
+
+        loads = iter(
+            [
+                ObservedLoad(request_rate=0.5, avg_isl=512, avg_osl=64),
+                ObservedLoad(request_rate=30.0, avg_isl=1024, avg_osl=256),  # burst
+                ObservedLoad(request_rate=0.2, avg_isl=256, avg_osl=32),  # cooldown
+            ]
+        )
+
+        async def observe():
+            return next(loads)
+
+        planner = Planner(cfg, connector, prefill, decode, observe)
+        planner.rate_predictor = ConstantPredictor()  # deterministic for test
+
+        p1 = await planner.step()
+        client = await ep.client()
+        await client.wait_for_instances(p1.decode, timeout=5)
+        n1 = len(client.instances)
+
+        p2 = await planner.step()  # burst → scale up
+        assert p2.decode > p1.decode
+        await client.wait_for_instances(p2.decode, timeout=5)
+
+        p3 = await planner.step()  # cooldown → scale down
+        assert p3.decode < p2.decode
+        for _ in range(100):
+            if len(client.instances) == p3.decode:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instances) == p3.decode
+
+        await connector.shutdown()
+    finally:
+        await drt.shutdown()
