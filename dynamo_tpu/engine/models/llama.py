@@ -94,15 +94,21 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, config: ModelConfig) -> jax.Array:
-    """q: [T, H, hd]; k/v: [S, KVH, hd]; mask: [T, S] bool → [T, H, hd]."""
-    groups = config.num_heads // config.num_kv_heads
-    k = jnp.repeat(k, groups, axis=1)  # [S, H, hd]
-    v = jnp.repeat(v, groups, axis=1)
+    """q: [T, H, hd]; k/v: [S, KVH, hd]; mask: [T, S] bool → [T, H, hd].
+
+    Grouped-query form: query heads are folded into (kv_head, group) so the
+    KV tensors are used as-is — no ``jnp.repeat`` materialization (which
+    would multiply HBM traffic by the group factor every layer)."""
+    T = q.shape[0]
+    kvh, hd = config.num_kv_heads, config.head_dim
+    groups = config.num_heads // kvh
+    qg = q.reshape(T, kvh, groups, hd)
     scale = config.head_dim ** -0.5
-    scores = jnp.einsum("thd,shd->hts", q, k).astype(jnp.float32) * scale
-    scores = jnp.where(mask[None, :, :], scores, -1e30)
+    scores = jnp.einsum("tkgd,skd->ktgs", qg, k).astype(jnp.float32) * scale
+    scores = jnp.where(mask[None, :, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("hts,shd->thd", probs, v)
+    out = jnp.einsum("ktgs,skd->tkgd", probs, v)
+    return out.reshape(T, config.num_heads, hd)
 
 
 # ---------------------------------------------------------------------------

@@ -357,9 +357,18 @@ class Scheduler:
         batch = self.running[:n]
         bucket = next_bucket(n, self.sc.decode_buckets)
 
+        # Bucket the block-table width by the longest sequence in the batch:
+        # the attention gather is O(table_width), so short contexts must not
+        # pay for max_seq_len (powers of two ⇒ bounded executable count).
+        max_used = max(len(seq.block_ids) for seq in batch)
+        width = 4
+        while width < max_used:
+            width *= 2
+        width = min(width, self.max_blocks_per_seq)
+
         tokens = np.zeros((bucket,), dtype=np.int32)
         positions = np.zeros((bucket,), dtype=np.int32)
-        tables = np.zeros((bucket, self.max_blocks_per_seq), dtype=np.int32)
+        tables = np.zeros((bucket, width), dtype=np.int32)
         active = np.zeros((bucket,), dtype=bool)
         temps = np.ones((bucket,), dtype=np.float32)
         top_ks = np.zeros((bucket,), dtype=np.int32)
