@@ -1,0 +1,2 @@
+"""Attention implementations: XLA paged gather (default), ring attention for
+sequence/context parallelism, Pallas kernels for TPU hot paths."""

@@ -1,0 +1,118 @@
+"""Ring attention: causal attention over sequence-sharded Q/K/V.
+
+The reference has **no** sequence/context parallelism — long context is
+handled by KV offload + disaggregation + engine-side TP (SURVEY.md §2e).
+Ring attention is dynamo-tpu's genuinely new engine capability: shard the
+sequence over the ``sp`` mesh axis, rotate K/V shards around the ring with
+``ppermute`` (ICI neighbor exchanges — the cheapest collective on a TPU
+torus), and accumulate attention with an online-softmax (flash-style) state
+so no device ever materializes the full sequence.
+
+Math: per ring step the local state (m, l, o) merges a new score block via
+the standard log-sum-exp update; after ``axis_size`` rotations every Q shard
+has attended to every K/V shard. Causality is enforced per (q_shard,
+kv_shard) pair on global positions: shards strictly in the future are
+skipped-by-masking (fully masked rows contribute zero weight).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, scale, causal):
+    """One Q-shard × KV-shard attention block in grouped-query form.
+
+    q: [T, KVH, G, hd]; k/v: [S, KVH, hd] → (scores_max [T,KVH,G],
+    exp_scores [KVH,T,G,S], value_part [T,KVH,G,hd] pieces via caller).
+    Returns (m_block, p, pv): row max, exp'd scores, and p@v.
+    """
+    scores = jnp.einsum("tkgd,skd->ktgs", q, k).astype(jnp.float32) * scale  # [KVH,T,G,S]
+    if causal:
+        T, S = q.shape[0], k.shape[0]
+        qpos = q_offset + jnp.arange(T)
+        kpos = kv_offset + jnp.arange(S)
+        mask = qpos[:, None] >= kpos[None, :]  # [T, S]
+        scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [KVH, T, G]
+    p = jnp.exp(scores - m[..., None])
+    # Fully-masked rows: m = NEG_INF ⇒ force p to 0 (exp(0)=1 otherwise).
+    p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
+    pv = jnp.einsum("ktgs,skd->ktgd", p.astype(v.dtype), v).astype(jnp.float32)  # [KVH,T,G,hd]
+    return m, p, pv
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Runs inside shard_map: q/k/v are the local sequence shards.
+
+    q: [T_local, H, hd]; k/v: [S_local, KVH, hd].
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    T, H, hd = q.shape
+    S, KVH, _ = k.shape
+    G = H // KVH
+    qg = q.reshape(T, KVH, G, hd)
+    q_offset = idx * T
+
+    # Online-softmax accumulators (pvary: the loop makes them device-varying,
+    # so the carry must start that way for shard_map's type system).
+    m_acc = lax.pvary(jnp.full((KVH, T, G), NEG_INF, dtype=jnp.float32), axis_name)
+    l_acc = lax.pvary(jnp.zeros((KVH, T, G), dtype=jnp.float32), axis_name)
+    o_acc = lax.pvary(jnp.zeros((KVH, T, G, hd), dtype=jnp.float32), axis_name)
+
+    def body(r, carry):
+        m_acc, l_acc, o_acc, k_cur, v_cur = carry
+        src = (idx - r) % n  # which shard these K/V came from
+        kv_offset = src * S
+        m_blk, p, pv = _block_attend(qg, k_cur, v_cur, q_offset, kv_offset, scale, causal)
+        m_new = jnp.maximum(m_acc, m_blk)
+        # Rescale old state and the new block into the shared max.
+        alpha = jnp.exp(jnp.where(m_acc <= NEG_INF / 2, NEG_INF, m_acc - m_new))
+        beta = jnp.exp(jnp.where(m_blk <= NEG_INF / 2, NEG_INF, m_blk - m_new))
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1) * beta
+        o_new = o_acc * alpha[..., None] + pv * beta[..., None]
+        # Rotate K/V one step around the ring (neighbor exchange on ICI).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, o_new, k_nxt, v_nxt
+
+    m_acc, l_acc, o_acc, _, _ = lax.fori_loop(0, n, body, (m_acc, l_acc, o_acc, k, v))
+    out = o_acc / jnp.maximum(l_acc[..., None], 1e-30)
+    # [KVH, T, G, hd] → [T, H, hd]
+    return out.transpose(1, 0, 2, 3).reshape(T, H, hd).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal attention with the sequence sharded over ``axis_name``.
+
+    q: [T, H, hd], k/v: [T, KVH, hd] — global shapes; T must divide by the
+    axis size. Returns [T, H, hd] with the same sharding as q.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(axis_name, None, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_sharded, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

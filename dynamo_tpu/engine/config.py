@@ -62,6 +62,51 @@ PRESETS = {
         block_size=16,
         rope_theta=10000.0,
     ),
+    # Tiny MoE config for EP tests.
+    "tiny-moe": ModelConfig(
+        name="tiny-moe",
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=64,
+        max_seq_len=256,
+        block_size=16,
+        rope_theta=10000.0,
+        num_experts=4,
+        num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=1000000.0,
+        max_seq_len=32768,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
+    # Wide-EP MoE decode target (ref recipe: recipes/gpt-oss-120b) —
+    # architecture approximated from public specs.
+    "gpt-oss-120b": ModelConfig(
+        name="gpt-oss-120b",
+        vocab_size=201088,
+        hidden_size=2880,
+        num_layers=36,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=64,
+        intermediate_size=2880,
+        max_seq_len=131072,
+        num_experts=128,
+        num_experts_per_tok=4,
+    ),
     "llama-3.2-1b": ModelConfig(
         name="llama-3.2-1b",
         vocab_size=128256,

@@ -46,21 +46,33 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
         return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
 
     L = c.num_layers
-    keys = jax.random.split(k_layers, 7)
+    keys = jax.random.split(k_layers, 8)
+    layers: Dict[str, jax.Array] = {
+        "attn_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+        "mlp_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+        "wq": dense(keys[0], (L, c.hidden_size, c.q_size)),
+        "wk": dense(keys[1], (L, c.hidden_size, c.kv_size)),
+        "wv": dense(keys[2], (L, c.hidden_size, c.kv_size)),
+        "wo": dense(keys[3], (L, c.q_size, c.hidden_size)),
+    }
+    if c.num_experts == 0:
+        layers.update(
+            w_gate=dense(keys[4], (L, c.hidden_size, c.intermediate_size)),
+            w_up=dense(keys[5], (L, c.hidden_size, c.intermediate_size)),
+            w_down=dense(keys[6], (L, c.intermediate_size, c.hidden_size)),
+        )
+    else:
+        E = c.num_experts
+        layers.update(
+            router=dense(keys[7], (L, c.hidden_size, E)),
+            w_gate=dense(keys[4], (L, E, c.hidden_size, c.intermediate_size)),
+            w_up=dense(keys[5], (L, E, c.hidden_size, c.intermediate_size)),
+            w_down=dense(keys[6], (L, E, c.intermediate_size, c.hidden_size)),
+        )
     params: Params = {
         "embed": dense(k_embed, (c.vocab_size, c.hidden_size), scale=0.02),
         "final_norm": jnp.ones((c.hidden_size,), dtype=dtype),
-        "layers": {
-            "attn_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
-            "mlp_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
-            "wq": dense(keys[0], (L, c.hidden_size, c.q_size)),
-            "wk": dense(keys[1], (L, c.hidden_size, c.kv_size)),
-            "wv": dense(keys[2], (L, c.hidden_size, c.kv_size)),
-            "wo": dense(keys[3], (L, c.q_size, c.hidden_size)),
-            "w_gate": dense(keys[4], (L, c.hidden_size, c.intermediate_size)),
-            "w_up": dense(keys[5], (L, c.hidden_size, c.intermediate_size)),
-            "w_down": dense(keys[6], (L, c.intermediate_size, c.hidden_size)),
-        },
+        "layers": layers,
     }
     if not c.tie_word_embeddings:
         params["lm_head"] = dense(k_head, (c.hidden_size, c.vocab_size), scale=0.02)
@@ -91,6 +103,30 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def _mlp(x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig) -> jax.Array:
+    """Feed-forward block: dense SwiGLU, or MoE when config.num_experts > 0.
+
+    MoE uses dense dispatch (every expert computes every token, combined by
+    router weights) — simple and correct under jit; expert tensors shard over
+    the ``ep`` mesh axis so GSPMD reduces partial expert outputs with one
+    psum (wide-EP sparse dispatch is the optimization path). The reference
+    only *configures* EP in its engines (SURVEY.md §2e); here it is native.
+    """
+    if config.num_experts == 0:
+        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    T = x.shape[0]
+    E, K = config.num_experts, config.num_experts_per_tok
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [T, E]
+    top_vals, top_idx = lax.top_k(router_logits, K)
+    weights = jax.nn.softmax(top_vals, axis=-1).astype(x.dtype)  # [T, K]
+    combine = jnp.zeros((T, E), dtype=x.dtype).at[jnp.arange(T)[:, None], top_idx].set(weights)
+    g = jnp.einsum("td,edf->tef", x, lp["w_gate"])
+    u = jnp.einsum("td,edf->tef", x, lp["w_up"])
+    h = jax.nn.silu(g) * u
+    out = jnp.einsum("tef,efd->ted", h, lp["w_down"])
+    return jnp.einsum("ted,te->td", out, combine)
 
 
 def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, config: ModelConfig) -> jax.Array:
@@ -165,7 +201,7 @@ def prefill(
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        h = h + _mlp(x, lp, c)
         return h, (kc, vc)
 
     h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
@@ -229,7 +265,7 @@ def decode(
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        h = h + _mlp(x, lp, c)
         return h, (kc, vc)
 
     h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))

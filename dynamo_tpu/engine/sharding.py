@@ -51,8 +51,11 @@ def build_mesh(parallel: ParallelConfig, devices: Optional[Sequence[jax.Device]]
     return Mesh(arr, axis_names=("dp", "sp", "ep", "tp"))
 
 
-def param_specs(tie_word_embeddings: bool) -> dict:
-    """PartitionSpec pytree matching llama.init_params structure."""
+def param_specs(tie_word_embeddings: bool, num_experts: int = 0) -> dict:
+    """PartitionSpec pytree matching llama.init_params structure.
+
+    MoE: experts shard over ``ep`` and the FFN hidden dim over ``tp`` —
+    the wide-EP layout (each chip holds E/ep experts, each split tp-ways)."""
     specs = {
         "embed": P("tp", None),
         "final_norm": P(None),
@@ -63,11 +66,21 @@ def param_specs(tie_word_embeddings: bool) -> dict:
             "wk": P(None, None, "tp"),
             "wv": P(None, None, "tp"),
             "wo": P(None, "tp", None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
         },
     }
+    if num_experts == 0:
+        specs["layers"].update(
+            w_gate=P(None, None, "tp"),
+            w_up=P(None, None, "tp"),
+            w_down=P(None, "tp", None),
+        )
+    else:
+        specs["layers"].update(
+            router=P(None, None, None),
+            w_gate=P(None, "ep", None, "tp"),
+            w_up=P(None, "ep", None, "tp"),
+            w_down=P(None, "ep", "tp", None),
+        )
     if not tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
@@ -82,8 +95,8 @@ def kv_cache_spec(num_kv_heads: int = 0, tp_size: int = 1) -> P:
     return P(None, None, None, None, None)
 
 
-def shard_params(params, mesh: Mesh, tie_word_embeddings: bool):
-    specs = param_specs(tie_word_embeddings)
+def shard_params(params, mesh: Mesh, tie_word_embeddings: bool, num_experts: int = 0):
+    specs = param_specs(tie_word_embeddings, num_experts)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,
