@@ -153,11 +153,69 @@ class RadixTree:
 
     @classmethod
     def load(cls, raw: bytes) -> "RadixTree":
-        tree = cls()
-        for rec in json.loads(raw):
-            for w in rec["w"]:
-                tree.apply_stored(w, [rec["h"]], rec["p"])
-        return tree
+        return _load_into(cls(), raw)
+
+
+class NativeRadixTree:
+    """Same interface as :class:`RadixTree`, backed by the C++ extension
+    (``native/dynamo_tpu_native.cc`` — the equivalent of the reference's
+    native indexer.rs hot path)."""
+
+    def __init__(self, _impl=None):
+        from dynamo_tpu.native import get_native
+
+        self._impl = _impl if _impl is not None else get_native().RadixTree()
+
+    def find_matches(self, block_hashes: Sequence[BlockHash], early_exit: bool = False) -> OverlapScores:
+        return OverlapScores(scores=self._impl.find_matches(list(block_hashes), early_exit=early_exit))
+
+    def size(self) -> int:
+        return self._impl.size()
+
+    def workers(self) -> List[WorkerId]:
+        return self._impl.workers()
+
+    def apply_stored(
+        self, worker: WorkerId, block_hashes: Sequence[BlockHash], parent_hash: Optional[BlockHash]
+    ) -> None:
+        self._impl.apply_stored(worker, list(block_hashes), parent_hash)
+
+    def apply_removed(self, worker: WorkerId, block_hashes: Sequence[BlockHash]) -> None:
+        self._impl.apply_removed(worker, list(block_hashes))
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        self._impl.remove_worker(worker)
+
+    def dump(self) -> bytes:
+        out = [{"h": h, "p": p, "w": ws} for h, p, ws in self._impl.dump_records()]
+        return json.dumps(out).encode()
+
+    @classmethod
+    def load(cls, raw: bytes) -> "NativeRadixTree":
+        return _load_into(cls(), raw)
+
+
+def _load_into(tree, raw: bytes):
+    """Restore snapshot records (BFS order: parents before children) into any
+    tree implementation. One place owns the {"h","p","w"} record schema."""
+    for rec in json.loads(raw):
+        for w in rec["w"]:
+            tree.apply_stored(w, [rec["h"]], rec["p"])
+    return tree
+
+
+def make_radix_tree():
+    """Native C++ tree when built, pure-Python fallback otherwise."""
+    from dynamo_tpu.native import available
+
+    return NativeRadixTree() if available() else RadixTree()
+
+
+def load_radix(raw: bytes):
+    """Restore a snapshot into whichever tree implementation is active."""
+    from dynamo_tpu.native import available
+
+    return NativeRadixTree.load(raw) if available() else RadixTree.load(raw)
 
 
 class KvIndexer:
@@ -166,7 +224,7 @@ class KvIndexer:
     irrelevant (per-worker state is independent)."""
 
     def __init__(self, block_size: int = 16):
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()
         self.block_size = block_size
         self.events_applied = 0
 

@@ -15,7 +15,7 @@ import asyncio
 import json
 from typing import Optional
 
-from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, load_radix
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.transports.kvstore import KeyExists
 
@@ -54,7 +54,7 @@ class KvRouterSubscriber:
             snap = await bucket.get(self.stream_name)
             if snap is not None:
                 try:
-                    self.indexer.tree = RadixTree.load(snap)
+                    self.indexer.tree = load_radix(snap)
                     logger.info("restored radix snapshot: %d nodes", self.indexer.tree.size())
                 except Exception:
                     logger.exception("radix snapshot restore failed; starting empty")

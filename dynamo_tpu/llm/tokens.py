@@ -22,10 +22,10 @@ import xxhash
 # own constant; any fixed seed works as long as engine + router agree.
 ROOT_SEED = 0x6462_6C6B  # "dblk"
 
-try:  # optional native hot path (built by native/setup.py)
-    from dynamo_tpu_native import hash_token_blocks as _native_hash_blocks  # type: ignore
-except Exception:  # pragma: no cover - native ext optional
-    _native_hash_blocks = None
+from dynamo_tpu.native import get_native
+
+_native = get_native()
+_native_hash_blocks = _native.hash_token_blocks if _native is not None else None
 
 BlockHash = int
 SequenceHash = int

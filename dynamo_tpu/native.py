@@ -1,0 +1,140 @@
+"""Loader for the optional C++ extension (``native/dynamo_tpu_native.cc``).
+
+The native module provides the framework's hot paths — chained xxh3 block
+hashing and the router radix tree (ref: lib/tokens/src/lib.rs and
+lib/llm/src/kv_router/indexer.rs are native Rust in the reference for the
+same reason). Pure-Python fallbacks exist everywhere; this module tries to
+import the built extension and, failing that, builds it once in-tree.
+
+Build hygiene: a file lock serializes concurrent builders (frontend + N
+workers all importing at startup), the result — success or failure — is
+stamped with the source mtime so a doomed build is attempted once per
+source change rather than once per process, and compiler output goes to
+``native/build/build.log``. Set ``DYN_NATIVE=0`` to force pure Python.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import importlib
+import logging
+import os
+import subprocess
+import sys
+
+logger = logging.getLogger(__name__)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_SRC = os.path.join(_NATIVE_DIR, "dynamo_tpu_native.cc")
+_STAMP = os.path.join(_BUILD_DIR, ".build_stamp")  # "<src_mtime> <ok|fail>"
+_LOG = os.path.join(_BUILD_DIR, "build.log")
+
+_module = None
+_tried = False
+
+
+def _try_import():
+    if _BUILD_DIR not in sys.path and os.path.isdir(_BUILD_DIR):
+        sys.path.insert(0, _BUILD_DIR)
+    try:
+        return importlib.import_module("dynamo_tpu_native")
+    except ImportError:
+        return None
+
+
+def _src_mtime() -> float:
+    try:
+        return os.path.getmtime(_SRC)
+    except OSError:
+        return 0.0
+
+
+def _stamp_state() -> str | None:
+    """'ok'/'fail' if a build for the current source was already attempted."""
+    try:
+        with open(_STAMP) as f:
+            mtime_s, state = f.read().split()
+        if float(mtime_s) == _src_mtime():
+            return state
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _have_built_so() -> bool:
+    return bool(glob.glob(os.path.join(_BUILD_DIR, "dynamo_tpu_native*.so")))
+
+
+@contextlib.contextmanager
+def _build_lock():
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    path = os.path.join(_BUILD_DIR, ".lock")
+    fd = os.open(path, os.O_CREAT | os.O_RDWR)
+    try:
+        import fcntl
+
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        os.close(fd)
+
+
+def _build() -> None:
+    """Build under the lock; stamp the outcome so failures don't repeat."""
+    with _build_lock():
+        # Another process may have finished while we waited on the lock.
+        if _stamp_state() is not None:
+            return
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(_NATIVE_DIR, "setup.py")],
+                cwd=_NATIVE_DIR,
+                capture_output=True,
+                text=True,
+                timeout=180,
+            )
+            ok = proc.returncode == 0
+            with open(_LOG, "w") as f:
+                f.write(proc.stdout + "\n" + proc.stderr)
+        except Exception as e:  # compiler missing, timeout, …
+            ok = False
+            with contextlib.suppress(OSError):
+                with open(_LOG, "w") as f:
+                    f.write(f"build invocation failed: {e}\n")
+        tmp = _STAMP + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{_src_mtime()} {'ok' if ok else 'fail'}")
+        os.replace(tmp, _STAMP)  # atomic: readers never see a partial stamp
+        if not ok:
+            logger.warning(
+                "native extension build failed (pure-Python fallback active); see %s", _LOG
+            )
+
+
+def get_native():
+    """The extension module, or None (pure-Python mode)."""
+    global _module, _tried
+    if _tried:
+        return _module
+    _tried = True
+    if os.environ.get("DYN_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SRC):  # installed without sources: import-or-nothing
+        _module = _try_import()
+        return _module
+    state = _stamp_state()
+    if state is None or (state == "ok" and not _have_built_so()):
+        _build()
+        state = _stamp_state()
+    if state == "ok":
+        _module = _try_import()
+        if _module is None:
+            logger.warning("native extension built but import failed; pure-Python fallback")
+    return _module
+
+
+def available() -> bool:
+    return get_native() is not None

@@ -1,0 +1,459 @@
+// dynamo_tpu_native: C++ hot paths for the router/token layer.
+//
+// TPU-native equivalents of the reference's native components (SURVEY.md §2):
+//   - token block/sequence hashing  (ref: lib/tokens/src/lib.rs, 611 LoC Rust;
+//     lib/llm/src/tokens.rs compute_hash_v2 = xxh3_64_with_seed)
+//   - radix-tree prefix indexer     (ref: lib/llm/src/kv_router/indexer.rs
+//     RadixTree :224 — the router's hottest data structure)
+//
+// Exposed as a CPython extension (no pybind11 in this image). The Python
+// layer (dynamo_tpu.llm.tokens / kv_router.indexer) falls back to pure
+// Python when this module is not built; semantics are identical and tested
+// for parity in tests/test_native.py.
+//
+// xxhash: uses the vendored single-header implementation shipped inside the
+// environment (XXH3 spec is stable; bit-compatible with the python `xxhash`
+// wheel, which the fallback path uses).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define XXH_INLINE_ALL
+#include <xxhash.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <memory>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// hashing
+// ---------------------------------------------------------------------------
+
+// Hash little-endian u32 token ids with a seed (chained from parent block).
+static uint64_t hash_u32_span(const uint32_t* data, size_t n, uint64_t seed) {
+#if __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+  return XXH3_64bits_withSeed(data, n * 4, seed);
+#else
+  std::vector<uint8_t> buf(n * 4);
+  for (size_t i = 0; i < n; i++) {
+    buf[i * 4 + 0] = data[i] & 0xff;
+    buf[i * 4 + 1] = (data[i] >> 8) & 0xff;
+    buf[i * 4 + 2] = (data[i] >> 16) & 0xff;
+    buf[i * 4 + 3] = (data[i] >> 24) & 0xff;
+  }
+  return XXH3_64bits_withSeed(buf.data(), buf.size(), seed);
+#endif
+}
+
+static bool tokens_to_u32(PyObject* seq, std::vector<uint32_t>* out) {
+  PyObject* fast = PySequence_Fast(seq, "tokens must be a sequence of ints");
+  if (!fast) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  out->resize((size_t)n);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long long v = PyLong_AsLongLong(items[i]);
+    if (v == -1 && PyErr_Occurred()) {
+      Py_DECREF(fast);
+      return false;
+    }
+    (*out)[(size_t)i] = (uint32_t)v;
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+// hash_tokens(tokens, seed) -> int (u64)
+static PyObject* py_hash_tokens(PyObject*, PyObject* args) {
+  PyObject* seq;
+  unsigned long long seed;
+  if (!PyArg_ParseTuple(args, "OK", &seq, &seed)) return nullptr;
+  std::vector<uint32_t> toks;
+  if (!tokens_to_u32(seq, &toks)) return nullptr;
+  uint64_t h = hash_u32_span(toks.data(), toks.size(), seed);
+  return PyLong_FromUnsignedLongLong(h);
+}
+
+// hash_token_blocks(tokens, block_size, seed) -> list[u64]  (chained)
+static PyObject* py_hash_token_blocks(PyObject*, PyObject* args) {
+  PyObject* seq;
+  Py_ssize_t block_size;
+  unsigned long long seed;
+  if (!PyArg_ParseTuple(args, "OnK", &seq, &block_size, &seed)) return nullptr;
+  if (block_size <= 0) {
+    PyErr_SetString(PyExc_ValueError, "block_size must be > 0");
+    return nullptr;
+  }
+  std::vector<uint32_t> toks;
+  if (!tokens_to_u32(seq, &toks)) return nullptr;
+  size_t n_full = toks.size() / (size_t)block_size;
+  std::vector<uint64_t> hashes(n_full);
+  {
+    // Pure C++ loop — release the GIL for long sequences.
+    Py_BEGIN_ALLOW_THREADS;
+    uint64_t s = seed;
+    for (size_t i = 0; i < n_full; i++) {
+      s = hash_u32_span(toks.data() + i * (size_t)block_size,
+                        (size_t)block_size, s);
+      hashes[i] = s;
+    }
+    Py_END_ALLOW_THREADS;
+  }
+  PyObject* out = PyList_New((Py_ssize_t)n_full);
+  if (!out) return nullptr;
+  for (size_t i = 0; i < n_full; i++) {
+    PyObject* v = PyLong_FromUnsignedLongLong(hashes[i]);
+    if (!v) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, v);
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// radix tree (ref: indexer.rs RadixTree :224)
+// ---------------------------------------------------------------------------
+
+struct Node {
+  uint64_t hash = 0;
+  Node* parent = nullptr;
+  bool is_root = false;
+  std::unordered_set<uint64_t> workers;
+  std::unordered_map<uint64_t, Node*> children;
+};
+
+struct Tree {
+  Node root;
+  std::unordered_map<uint64_t, Node*> by_hash;
+  std::unordered_map<uint64_t, std::unordered_set<uint64_t>> worker_nodes;
+
+  Tree() { root.is_root = true; }
+  ~Tree() { clear(); }
+
+  void clear() {
+    for (auto& kv : by_hash) delete kv.second;
+    by_hash.clear();
+    worker_nodes.clear();
+    root.children.clear();
+  }
+
+  void apply_stored(uint64_t worker, const std::vector<uint64_t>& hashes,
+                    bool has_parent, uint64_t parent_hash) {
+    Node* parent = &root;
+    if (has_parent) {
+      auto it = by_hash.find(parent_hash);
+      // Orphan chain (missed parent event): root it so partial matching
+      // still works — mirrors the Python fallback and ref behavior.
+      if (it != by_hash.end()) parent = it->second;
+    }
+    Node* node = parent;
+    for (uint64_t h : hashes) {
+      auto it = by_hash.find(h);
+      if (it != by_hash.end()) {
+        node = it->second;
+      } else {
+        auto cit = node->children.find(h);
+        Node* child;
+        if (cit != node->children.end()) {
+          child = cit->second;
+        } else {
+          child = new Node();
+          child->hash = h;
+          child->parent = node;
+          node->children.emplace(h, child);
+          by_hash.emplace(h, child);
+        }
+        node = child;
+      }
+      node->workers.insert(worker);
+      worker_nodes[worker].insert(h);
+    }
+  }
+
+  void maybe_prune(Node* node) {
+    while (!node->is_root && node->workers.empty() && node->children.empty()) {
+      Node* parent = node->parent;
+      parent->children.erase(node->hash);
+      by_hash.erase(node->hash);
+      delete node;
+      node = parent;
+    }
+  }
+
+  void apply_removed(uint64_t worker, const std::vector<uint64_t>& hashes) {
+    for (uint64_t h : hashes) {
+      auto it = by_hash.find(h);
+      if (it == by_hash.end()) continue;
+      Node* node = it->second;
+      node->workers.erase(worker);
+      auto wn = worker_nodes.find(worker);
+      if (wn != worker_nodes.end()) wn->second.erase(h);
+      maybe_prune(node);
+    }
+  }
+
+  void remove_worker(uint64_t worker) {
+    auto wn = worker_nodes.find(worker);
+    if (wn != worker_nodes.end()) {
+      // Copy: prune mutates by_hash.
+      std::vector<uint64_t> hashes(wn->second.begin(), wn->second.end());
+      for (uint64_t h : hashes) {
+        auto it = by_hash.find(h);
+        if (it == by_hash.end()) continue;
+        Node* node = it->second;
+        node->workers.erase(worker);
+        maybe_prune(node);
+      }
+      worker_nodes.erase(worker);
+    }
+  }
+};
+
+typedef struct {
+  PyObject_HEAD
+  Tree* tree;
+} RadixTreeObject;
+
+static int RadixTree_init(RadixTreeObject* self, PyObject*, PyObject*) {
+  self->tree = new Tree();
+  return 0;
+}
+
+static void RadixTree_dealloc(RadixTreeObject* self) {
+  delete self->tree;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static bool hashes_to_u64(PyObject* seq, std::vector<uint64_t>* out) {
+  PyObject* fast = PySequence_Fast(seq, "block_hashes must be a sequence");
+  if (!fast) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  out->resize((size_t)n);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint64_t v = PyLong_AsUnsignedLongLong(items[i]);
+    if (v == (uint64_t)-1 && PyErr_Occurred()) {
+      Py_DECREF(fast);
+      return false;
+    }
+    (*out)[(size_t)i] = v;
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+// apply_stored(worker, block_hashes, parent_hash_or_None)
+static PyObject* RadixTree_apply_stored(RadixTreeObject* self, PyObject* args) {
+  unsigned long long worker;
+  PyObject* hashes_obj;
+  PyObject* parent_obj;
+  if (!PyArg_ParseTuple(args, "KOO", &worker, &hashes_obj, &parent_obj))
+    return nullptr;
+  std::vector<uint64_t> hashes;
+  if (!hashes_to_u64(hashes_obj, &hashes)) return nullptr;
+  bool has_parent = parent_obj != Py_None;
+  uint64_t parent_hash = 0;
+  if (has_parent) {
+    parent_hash = PyLong_AsUnsignedLongLong(parent_obj);
+    if (parent_hash == (uint64_t)-1 && PyErr_Occurred()) return nullptr;
+  }
+  self->tree->apply_stored(worker, hashes, has_parent, parent_hash);
+  Py_RETURN_NONE;
+}
+
+static PyObject* RadixTree_apply_removed(RadixTreeObject* self, PyObject* args) {
+  unsigned long long worker;
+  PyObject* hashes_obj;
+  if (!PyArg_ParseTuple(args, "KO", &worker, &hashes_obj)) return nullptr;
+  std::vector<uint64_t> hashes;
+  if (!hashes_to_u64(hashes_obj, &hashes)) return nullptr;
+  self->tree->apply_removed(worker, hashes);
+  Py_RETURN_NONE;
+}
+
+static PyObject* RadixTree_remove_worker(RadixTreeObject* self, PyObject* args) {
+  unsigned long long worker;
+  if (!PyArg_ParseTuple(args, "K", &worker)) return nullptr;
+  self->tree->remove_worker(worker);
+  Py_RETURN_NONE;
+}
+
+// find_matches(block_hashes, early_exit=False) -> dict[worker, depth]
+static PyObject* RadixTree_find_matches(RadixTreeObject* self, PyObject* args,
+                                        PyObject* kwargs) {
+  PyObject* hashes_obj;
+  int early_exit = 0;
+  static const char* kwlist[] = {"block_hashes", "early_exit", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O|p", (char**)kwlist,
+                                   &hashes_obj, &early_exit))
+    return nullptr;
+  std::vector<uint64_t> hashes;
+  if (!hashes_to_u64(hashes_obj, &hashes)) return nullptr;
+
+  std::unordered_map<uint64_t, int64_t> scores;
+  {
+    Node* node = &self->tree->root;
+    int64_t depth = 0;
+    for (uint64_t h : hashes) {
+      auto it = node->children.find(h);
+      if (it == node->children.end()) break;
+      depth++;
+      node = it->second;
+      for (uint64_t w : node->workers) scores[w] = depth;
+      if (early_exit && node->children.empty()) break;
+    }
+  }
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  for (auto& kv : scores) {
+    PyObject* k = PyLong_FromUnsignedLongLong(kv.first);
+    PyObject* v = PyLong_FromLongLong(kv.second);
+    if (!k || !v || PyDict_SetItem(out, k, v) < 0) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(k);
+    Py_DECREF(v);
+  }
+  return out;
+}
+
+static PyObject* RadixTree_size(RadixTreeObject* self, PyObject*) {
+  return PyLong_FromSize_t(self->tree->by_hash.size());
+}
+
+static PyObject* RadixTree_workers(RadixTreeObject* self, PyObject*) {
+  std::vector<uint64_t> ws;
+  ws.reserve(self->tree->worker_nodes.size());
+  for (auto& kv : self->tree->worker_nodes) ws.push_back(kv.first);
+  std::sort(ws.begin(), ws.end());
+  PyObject* out = PyList_New((Py_ssize_t)ws.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < ws.size(); i++) {
+    PyObject* v = PyLong_FromUnsignedLongLong(ws[i]);
+    if (!v) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, v);
+  }
+  return out;
+}
+
+// dump_records() -> list[(hash, parent_hash_or_None, sorted_workers)]
+// BFS order so parents restore before children (snapshot format matches the
+// Python tree's dump()).
+static PyObject* RadixTree_dump_records(RadixTreeObject* self, PyObject*) {
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  std::vector<Node*> stack{&self->tree->root};
+  while (!stack.empty()) {
+    Node* node = stack.back();
+    stack.pop_back();
+    for (auto& kv : node->children) {
+      Node* child = kv.second;
+      PyObject* parent = node->is_root
+                             ? Py_NewRef(Py_None)
+                             : PyLong_FromUnsignedLongLong(node->hash);
+      std::vector<uint64_t> ws(child->workers.begin(), child->workers.end());
+      std::sort(ws.begin(), ws.end());
+      PyObject* wlist = PyList_New((Py_ssize_t)ws.size());
+      if (!parent || !wlist) {
+        Py_XDECREF(parent);
+        Py_XDECREF(wlist);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      for (size_t i = 0; i < ws.size(); i++)
+        PyList_SET_ITEM(wlist, (Py_ssize_t)i,
+                        PyLong_FromUnsignedLongLong(ws[i]));
+      PyObject* rec = Py_BuildValue("(KNN)", (unsigned long long)child->hash,
+                                    parent, wlist);
+      if (!rec || PyList_Append(out, rec) < 0) {
+        Py_XDECREF(rec);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(rec);
+      stack.push_back(child);
+    }
+  }
+  return out;
+}
+
+static PyObject* RadixTree_clear(RadixTreeObject* self, PyObject*) {
+  self->tree->clear();
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef RadixTree_methods[] = {
+    {"apply_stored", (PyCFunction)RadixTree_apply_stored, METH_VARARGS,
+     "apply_stored(worker, block_hashes, parent_hash_or_None)"},
+    {"apply_removed", (PyCFunction)RadixTree_apply_removed, METH_VARARGS,
+     "apply_removed(worker, block_hashes)"},
+    {"remove_worker", (PyCFunction)RadixTree_remove_worker, METH_VARARGS,
+     "remove_worker(worker)"},
+    {"find_matches", (PyCFunction)RadixTree_find_matches,
+     METH_VARARGS | METH_KEYWORDS,
+     "find_matches(block_hashes, early_exit=False) -> {worker: depth}"},
+    {"size", (PyCFunction)RadixTree_size, METH_NOARGS, "node count"},
+    {"workers", (PyCFunction)RadixTree_workers, METH_NOARGS,
+     "sorted worker ids"},
+    {"dump_records", (PyCFunction)RadixTree_dump_records, METH_NOARGS,
+     "snapshot records (hash, parent, workers) in BFS order"},
+    {"clear", (PyCFunction)RadixTree_clear, METH_NOARGS, "drop all state"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject RadixTreeType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+static PyMethodDef module_methods[] = {
+    {"hash_tokens", py_hash_tokens, METH_VARARGS,
+     "hash_tokens(tokens, seed) -> u64 (xxh3_64 over LE u32 ids)"},
+    {"hash_token_blocks", py_hash_token_blocks, METH_VARARGS,
+     "hash_token_blocks(tokens, block_size, seed) -> list[u64] (chained)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef native_module = {
+    PyModuleDef_HEAD_INIT,
+    "dynamo_tpu_native",
+    "C++ hot paths: token hashing + radix-tree prefix indexer",
+    -1,
+    module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_dynamo_tpu_native(void) {
+  RadixTreeType.tp_name = "dynamo_tpu_native.RadixTree";
+  RadixTreeType.tp_basicsize = sizeof(RadixTreeObject);
+  RadixTreeType.tp_flags = Py_TPFLAGS_DEFAULT;
+  RadixTreeType.tp_doc = "C++ radix tree over chained block hashes";
+  RadixTreeType.tp_new = PyType_GenericNew;
+  RadixTreeType.tp_init = (initproc)RadixTree_init;
+  RadixTreeType.tp_dealloc = (destructor)RadixTree_dealloc;
+  RadixTreeType.tp_methods = RadixTree_methods;
+  if (PyType_Ready(&RadixTreeType) < 0) return nullptr;
+
+  PyObject* m = PyModule_Create(&native_module);
+  if (!m) return nullptr;
+  Py_INCREF(&RadixTreeType);
+  if (PyModule_AddObject(m, "RadixTree", (PyObject*)&RadixTreeType) < 0) {
+    Py_DECREF(&RadixTreeType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -1,0 +1,41 @@
+"""Build the dynamo_tpu_native C++ extension.
+
+Usage: python native/setup.py build_ext --build-lib native/build
+
+No pybind11 in this image — plain CPython C API. The xxhash single-header
+implementation is taken from the environment (pyarrow vendors the upstream
+header); we do not vendor third-party code into the repo.
+"""
+
+import glob
+import os
+import sys
+
+from setuptools import Extension, setup
+
+
+def find_xxhash_include() -> str:
+    candidates = []
+    for site in sys.path:
+        if not site or not os.path.isdir(site):
+            continue
+        candidates += glob.glob(
+            os.path.join(site, "pyarrow", "include", "arrow", "vendored", "xxhash")
+        )
+    for c in candidates:
+        if os.path.exists(os.path.join(c, "xxhash.h")):
+            return c
+    raise SystemExit("xxhash.h not found in environment (need pyarrow include)")
+
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+ext = Extension(
+    "dynamo_tpu_native",
+    sources=[os.path.join(HERE, "dynamo_tpu_native.cc")],
+    include_dirs=[find_xxhash_include()],
+    extra_compile_args=["-O2", "-std=c++17", "-fvisibility=hidden"],
+    language="c++",
+)
+
+setup(name="dynamo_tpu_native", version="0.1.0", ext_modules=[ext], script_args=sys.argv[1:] or ["build_ext", "--build-lib", os.path.join(HERE, "build")])
