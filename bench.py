@@ -30,7 +30,8 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "64"))
     ctx_len = int(os.environ.get("BENCH_CTX", "1024"))
 
-    cfg = get_config(model).replace(max_seq_len=max(2048, ctx_len + 128))
+    attn = os.environ.get("BENCH_ATTN", "auto")  # auto|gather|paged_kernel
+    cfg = get_config(model).replace(max_seq_len=max(2048, ctx_len + 128), attention_impl=attn)
     num_blocks = batch * (ctx_len // cfg.block_size + 4) + 8
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)

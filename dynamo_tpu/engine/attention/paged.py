@@ -1,0 +1,190 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The XLA fallback in ``models/llama.py`` materializes the gathered context
+``kc[block_tables]`` — ``[B, ctx, KVH, HD]`` of HBM traffic per layer even
+for short sequences, because the gather length is the *bucketed* block-table
+width. This kernel is the TPU-native replacement (the role
+``block_copy.cu`` + FlashAttention play on the reference's GPU engines,
+SURVEY.md §2b N3): it walks each sequence's real block list, DMAs KV blocks
+HBM→VMEM with double buffering, and accumulates attention with an online
+softmax — HBM traffic is proportional to the *actual* context length, and
+no gathered copy of the cache is ever materialized.
+
+Mosaic alignment drives the layout: DMA slices must be lane-aligned (minor
+dim a multiple of 128), so KV pages move as ``[BS, KVH*HD]`` rows — the
+contiguous row of our ``[N, BS, KVH, HD]`` cache, and a 128-multiple for
+every real model (KVH*HD ≥ 512). Per-kv-head compute would need unaligned
+``HD``-sized lane slices, so the kernel never splits heads; instead the
+caller folds the grouped queries into a block-diagonal matrix
+``W[KVH*HD, KVH*G]`` (zeros off-block) and the kernel is just two matmuls
+per page:
+
+    scores[KVH*G, BS]   = Wᵀ · k_pageᵀ     (exact GQA scores — off-block
+                                            lanes contribute 0)
+    out_m[KVH*G, KVH*HD] += softmax(scores) · v_page
+
+All online-softmax state is rowwise (``[KVH*G, 1]``), so there are no
+in-kernel transposes or reshapes. The block-diagonal of ``out_m`` (the true
+attention output) is extracted outside the kernel in XLA. The ×KVH matmul
+overhead is immaterial: decode attention is HBM-bandwidth-bound and the DMA
+volume is unchanged.
+
+On non-TPU backends the same kernel runs in interpreter mode so unit tests
+exercise the identical code path (``interpret=True``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # SMEM [B, W] int32 — block ids per sequence
+    lens_ref,  # SMEM [B] int32 — kv length (positions + 1; 0 = inactive row)
+    # inputs
+    w_ref,  # VMEM [1, KVH*HD, KVH*G] — block-diagonal queries
+    k_hbm,  # ANY  [N, BS, KVH*HD]
+    v_hbm,  # ANY  [N, BS, KVH*HD]
+    # outputs
+    out_ref,  # VMEM [1, KVH*G, KVH*HD]
+    # scratch
+    k_buf,  # VMEM [2, BS, KVH*HD]
+    v_buf,  # VMEM [2, BS, KVH*HD]
+    sems,  # DMA sems [2, 2]
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kv_len = lens_ref[b]
+    n_pages = pl.cdiv(kv_len, block_size)
+
+    rows = w_ref.shape[2]  # KVH*G
+    merged = w_ref.shape[1]  # KVH*HD
+    bs = block_size
+
+    def page_dma(slot, page_idx):
+        block_id = tables_ref[b, page_idx]
+        k_dma = pltpu.make_async_copy(k_hbm.at[block_id], k_buf.at[slot], sems.at[slot, 0])
+        v_dma = pltpu.make_async_copy(v_hbm.at[block_id], v_buf.at[slot], sems.at[slot, 1])
+        return k_dma, v_dma
+
+    @pl.when(kv_len > 0)
+    def _():
+        for dma in page_dma(0, 0):
+            dma.start()
+
+    w = w_ref[0]  # [KVH*HD, KVH*G]
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            for dma in page_dma(lax.rem(i + 1, 2), i + 1):
+                dma.start()
+
+        for dma in page_dma(slot, i):
+            dma.wait()
+
+        k = k_buf[slot]  # [BS, KVH*HD]
+        v = v_buf[slot]
+
+        # scores[r, s] = Σ_c w[c, r] · k[s, c] — GQA scores for row r=(kvh,g):
+        # w is zero outside kvh's lane block, so cross-head lanes vanish.
+        scores = lax.dot_general(
+            w, k,
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [KVH*G, BS]
+
+        key_pos = i * bs + lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        scores = jnp.where(key_pos < kv_len, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))  # [rows, 1]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)  # [rows, BS]
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+
+        # out_m[r, c] += Σ_s p[r, s] · v[s, c]
+        pv = lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, merged]
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((rows, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((rows, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((rows, merged), dtype=jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l > 0.0, l, 1.0)
+    out_ref[0] = (acc / l_safe).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, HD]
+    k_cache: jax.Array,  # [N, BS, KVH, HD]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, W] int32
+    kv_lens: jax.Array,  # [B] int32 (0 for inactive rows)
+    *,
+    block_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single decode-step attention over the paged KV cache → [B, H, HD]."""
+    B, H, HD = q.shape
+    N, BS, KVH, _ = k_cache.shape
+    G = H // KVH
+    merged = KVH * HD
+    rows = KVH * G
+
+    # Block-diagonal fold: W[b, kvh*HD+d, kvh*G+g] = q[b, kvh, g, d].
+    q5 = q.reshape(B, KVH, G, HD)
+    eye = jnp.eye(KVH, dtype=q.dtype)
+    w = jnp.einsum("bkgd,kj->bkdjg", q5, eye).reshape(B, merged, rows)
+
+    # Minor-dims merge is layout-free; pages DMA as contiguous [BS, KVH*HD].
+    k_m = k_cache.reshape(N, BS, merged)
+    v_m = v_cache.reshape(N, BS, merged)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, merged, rows), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, rows, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, BS, merged), k_cache.dtype),
+            pltpu.VMEM((2, BS, merged), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out_m = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=block_size, scale=HD**-0.5),
+        out_shape=jax.ShapeDtypeStruct((B, rows, merged), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), w, k_m, v_m)
+
+    # Extract the block diagonal: out[b, kvh, g, :] = out_m[b, kvh*G+g, kvh*HD:+HD].
+    out5 = out_m.reshape(B, KVH, G, KVH, HD)
+    diag = jnp.diagonal(out5, axis1=1, axis2=3)  # [B, G, HD, KVH]
+    return jnp.transpose(diag, (0, 3, 1, 2)).reshape(B, H, HD)

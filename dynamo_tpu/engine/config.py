@@ -34,6 +34,16 @@ class ModelConfig:
     # MoE (0 experts = dense).
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # Decode attention implementation: "auto" uses the Pallas paged-attention
+    # kernel on TPU and the XLA gather path elsewhere; "gather"/"paged_kernel"
+    # force one. (Static: picked at trace time, one executable per choice.)
+    attention_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("auto", "gather", "paged_kernel"):
+            raise ValueError(
+                f"attention_impl must be auto|gather|paged_kernel, got {self.attention_impl!r}"
+            )
 
     @property
     def q_size(self) -> int:

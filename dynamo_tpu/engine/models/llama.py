@@ -240,8 +240,23 @@ def decode(
     tgt_blocks = jnp.where(active, jnp.take_along_axis(block_tables, (slots // bs)[:, None], axis=1)[:, 0], 0)
     tgt_offs = slots % bs
 
+    # "auto" only picks the kernel single-chip (under a GSPMD mesh the
+    # pallas_call would need a shard_map wrapper; the gather path partitions
+    # fine) and only when KV pages are Mosaic-DMA-aligned: lane dim
+    # KVH*HD % 128, sublane BS % 8 (tiny test configs fall back to gather).
+    aligned = (c.kv_size % 128 == 0) and (c.block_size % 8 == 0)
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = c.attention_impl == "paged_kernel" or (
+        c.attention_impl == "auto" and aligned and on_tpu and jax.device_count() == 1
+    )
+    if c.attention_impl == "paged_kernel" and on_tpu and not aligned:
+        raise ValueError(
+            f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
+            f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
+        )
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
     mask = key_pos[None, :] <= positions[:, None]  # [B, ctx]
+    kv_lens = jnp.where(active, positions + 1, 0)
 
     def layer_fn(h, xs):
         lp, kc, vc = xs
@@ -256,12 +271,19 @@ def decode(
         kc = kc.at[tgt_blocks, tgt_offs].set(k)
         vc = vc.at[tgt_blocks, tgt_offs].set(v)
 
-        k_ctx = kc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
-        v_ctx = vc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+        if use_kernel:
+            from dynamo_tpu.engine.attention.paged import paged_decode_attention
 
-        attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
-            q, k_ctx, v_ctx, mask
-        )  # [B, H, hd]
+            attn = paged_decode_attention(
+                q, kc, vc, block_tables, kv_lens,
+                block_size=bs, interpret=jax.default_backend() != "tpu",
+            )  # [B, H, hd]
+        else:
+            k_ctx = kc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            v_ctx = vc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
+                q, k_ctx, v_ctx, mask
+            )  # [B, H, hd]
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
