@@ -1,0 +1,57 @@
+"""Embedding engine: serves /v1/embeddings requests on the same weights.
+
+Ref: the reference exposes /v1/embeddings (http/service/openai.rs:369) and
+routes it to engines registered with ModelType::Embedding. Here the engine
+runs ``llama.embed`` on bucketed lengths (one XLA executable per bucket,
+same compile-caching strategy as the scheduler's prefill buckets).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, List
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.scheduler import next_bucket
+from dynamo_tpu.runtime.engine import Context
+
+
+class EmbeddingEngine:
+    """AsyncEngine over ``llama.embed``. Request wire:
+    ``{"token_ids": [...]}` or ``{"batch_token_ids": [[...], ...]}``;
+    one response frame ``{"embeddings": [[...]], "finish_reason": "stop"}``.
+    """
+
+    def __init__(self, config: ModelConfig, params, buckets: List[int] | None = None):
+        self.config = config
+        self.params = params
+        self.buckets = buckets or [32, 128, 512, min(2048, config.max_seq_len)]
+        self._jit = jax.jit(
+            lambda p, t, n: llama.embed(p, self.config, t, n)
+        )
+
+    def _embed_one(self, ids: List[int]) -> List[float]:
+        ids = ids[: min(self.config.max_seq_len, self.buckets[-1])]
+        bucket = next_bucket(len(ids), self.buckets)
+        padded = jnp.zeros((bucket,), dtype=jnp.int32).at[: len(ids)].set(jnp.asarray(ids, dtype=jnp.int32))
+        out = self._jit(self.params, padded, jnp.int32(len(ids)))
+        return [float(x) for x in out]
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        batches = request.get("batch_token_ids")
+        if batches is None:
+            batches = [request.get("token_ids") or []]
+        embeddings = []
+        for ids in batches:
+            embeddings.append(await asyncio.to_thread(self._embed_one, list(ids)))
+        yield {
+            "embeddings": embeddings,
+            "prompt_tokens": sum(len(b) for b in batches),
+            "finish_reason": "stop",
+            "token_ids": [],
+            "index": 0,
+        }

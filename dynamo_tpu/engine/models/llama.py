@@ -213,6 +213,40 @@ def prefill(
     return logits.astype(jnp.float32), k_new, v_new
 
 
+def embed(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [T] bucket-padded token ids
+    valid_len: jax.Array,  # scalar
+) -> jax.Array:
+    """Sequence embedding: full causal forward (no KV cache), masked mean
+    pool over the final hidden states → [hidden_size] f32, L2-normalized.
+    (Serving path for /v1/embeddings — ref: http/service/openai.rs:369.)"""
+    c = config
+    T = tokens.shape[0]
+    h = params["embed"].at[tokens].get(mode="clip")  # [T, D]
+    positions = jnp.arange(T, dtype=jnp.int32)
+    valid = positions < valid_len
+    mask = (positions[None, :] <= positions[:, None]) & valid[None, :]
+
+    def layer_fn(h, lp):
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = apply_rope((x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim), positions, c.rope_theta)
+        k = apply_rope((x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
+        v = (x @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        attn = _attend(q, k, v, mask, c)
+        h = h + attn.reshape(T, c.q_size) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        h = h + _mlp(x, lp, c)
+        return h, None
+
+    h, _ = lax.scan(layer_fn, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps).astype(jnp.float32)
+    weights = valid.astype(jnp.float32)[:, None]
+    pooled = jnp.sum(h * weights, axis=0) / jnp.maximum(jnp.sum(weights), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------

@@ -7,8 +7,8 @@ one compiled sampler serves mixed-parameter batches.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +22,8 @@ class SamplingParams:
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0
     seed: Optional[int] = None
+    # Per-request processors (dynamo_tpu.logits_processing) — host path.
+    logits_processors: List = field(default_factory=list)
 
     @property
     def greedy(self) -> bool:

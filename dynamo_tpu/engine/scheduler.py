@@ -392,6 +392,19 @@ class Scheduler:
             jnp.asarray(tables),
             jnp.asarray(active),
         )
+        # Per-request logits processors (dynamo_tpu.logits_processing): the
+        # host path — one device→host sync for the rows that opted in, so
+        # processor-free batches stay on the fast path.
+        if any(seq.sampling.logits_processors for seq in batch):
+            from dynamo_tpu.logits_processing import apply_chain
+
+            rows = np.array(logits)  # writable host copy
+            for i, seq in enumerate(batch):
+                if seq.sampling.logits_processors:
+                    rows[i] = np.asarray(
+                        apply_chain(seq.sampling.logits_processors, seq.output_ids, jnp.asarray(rows[i]))
+                    )
+            logits = jnp.asarray(rows)
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
         sampled = np.asarray(
@@ -472,6 +485,10 @@ class Scheduler:
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
         s = seq.sampling
+        if s.logits_processors:
+            from dynamo_tpu.logits_processing import apply_chain
+
+            logits = apply_chain(s.logits_processors, seq.output_ids, logits)
         tok = self._sample_jit(
             logits[None, :],
             jnp.asarray([s.temperature], dtype=jnp.float32),

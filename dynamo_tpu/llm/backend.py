@@ -70,6 +70,36 @@ class Backend(Operator):
         )
         decoder = DecodeStream(self.tokenizer, skip_token_ids=skip_ids)
         jail = StopStringJail(stop_strings)
+        parser_jail = _build_parser_jail(request.get("parser_options"))
+
+        def finalize(
+            out: LLMEngineOutput, emit_text: Optional[str], finish: str, *, include_tail: bool = True
+        ) -> LLMEngineOutput:
+            """Assemble the final frame, folding in parser results. On a
+            stop-string hit the detokenizer/jail tails are at/after the stop
+            string and must be dropped (include_tail=False)."""
+            tail = (decoder.flush() + jail.flush()) if include_tail else ""
+            text = (emit_text or "") + tail
+            tool_calls = None
+            reasoning = None
+            if parser_jail is not None:
+                r0, c0 = ("", text)
+                if text:
+                    r0, c0 = parser_jail.feed(text)
+                r1, c1, calls = parser_jail.finish()
+                reasoning = (r0 + r1) or None
+                text = c0 + c1
+                if calls:
+                    tool_calls = [c.to_openai() for c in calls]
+                    finish = "tool_calls"
+            return LLMEngineOutput(
+                token_ids=out.token_ids,
+                text=text or None,
+                finish_reason=finish,
+                index=out.index,
+                tool_calls=tool_calls,
+                reasoning=reasoning,
+            )
 
         async def gen():
             stopped = False
@@ -90,25 +120,37 @@ class Backend(Operator):
                 emit_text, hit = jail.feed(delta) if delta else (None, False)
                 if hit:
                     stopped = True
-                    if emit_text:
-                        yield Annotated(data=LLMEngineOutput(token_ids=out.token_ids, text=emit_text, index=out.index).to_wire())
-                    yield Annotated(data=LLMEngineOutput(finish_reason="stop", index=out.index).to_wire())
+                    yield Annotated(data=finalize(out, emit_text, "stop", include_tail=False).to_wire())
                     context.stop_generating()  # propagate abort to the engine
                     return
                 if out.finish_reason:
-                    tail = decoder.flush() + jail.flush()
+                    yield Annotated(data=finalize(out, emit_text, out.finish_reason).to_wire())
+                    return
+                reasoning_delta = None
+                if parser_jail is not None and emit_text:
+                    r, c = parser_jail.feed(emit_text)
+                    reasoning_delta, emit_text = (r or None), (c or None)
+                if emit_text or reasoning_delta or out.token_ids:
                     yield Annotated(
                         data=LLMEngineOutput(
                             token_ids=out.token_ids,
-                            text=(emit_text or "") + tail or None,
-                            finish_reason=out.finish_reason,
+                            text=emit_text,
                             index=out.index,
+                            reasoning=reasoning_delta,
                         ).to_wire()
-                    )
-                    return
-                if emit_text or out.token_ids:
-                    yield Annotated(
-                        data=LLMEngineOutput(token_ids=out.token_ids, text=emit_text, index=out.index).to_wire()
                     )
 
         return gen()
+
+
+def _build_parser_jail(parser_options: Optional[dict]):
+    if not parser_options:
+        return None
+    from dynamo_tpu.llm.parsers import StreamingToolCallJail, get_reasoning_parser, get_tool_parser
+    from dynamo_tpu.llm.parsers.tool_calling import ToolCallConfig
+
+    tool_name = parser_options.get("tool_call_parser")
+    reasoning_name = parser_options.get("reasoning_parser")
+    config = get_tool_parser(tool_name) if tool_name else ToolCallConfig(format="json", allow_bare_json=False)
+    reasoning = get_reasoning_parser(reasoning_name) if reasoning_name else None
+    return StreamingToolCallJail(config=config, reasoning=reasoning)

@@ -23,7 +23,7 @@ from dynamo_tpu.runtime.component import Endpoint
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
 from dynamo_tpu.runtime.logging import get_logger
-from dynamo_tpu.runtime.pipeline import link
+from dynamo_tpu.runtime.pipeline import Operator, link
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 
 logger = get_logger(__name__)
@@ -43,7 +43,13 @@ def build_local_pipeline(tokenizer: Tokenizer, engine: AsyncEngine, card: Option
     """Aggregated in-process pipeline: preprocessor → backend → engine
     (ref: EngineConfig::StaticFull)."""
     formatter = PromptFormatter(card.chat_template if card else None)
-    return link([OpenAIPreprocessor(tokenizer, formatter), Backend(tokenizer)], engine)
+    pre = OpenAIPreprocessor(
+        tokenizer,
+        formatter,
+        tool_call_parser=card.tool_call_parser if card else None,
+        reasoning_parser=card.reasoning_parser if card else None,
+    )
+    return link([pre, Backend(tokenizer)], engine)
 
 
 def build_routed_pipeline(
@@ -56,7 +62,13 @@ def build_routed_pipeline(
     """Frontend-side routed pipeline: preprocessor → backend → migration →
     router (ref: input/common.rs:226)."""
     formatter = PromptFormatter(card.chat_template if card else None)
-    ops = [OpenAIPreprocessor(tokenizer, formatter), Backend(tokenizer)]
+    pre = OpenAIPreprocessor(
+        tokenizer,
+        formatter,
+        tool_call_parser=card.tool_call_parser if card else None,
+        reasoning_parser=card.reasoning_parser if card else None,
+    )
+    ops = [pre, Backend(tokenizer)]
     limit = migration_limit if migration_limit else (card.migration_limit if card else 0)
     if limit > 0:
         ops.append(Migration(limit))
@@ -139,3 +151,29 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
     service.watcher = watcher  # keep alive / stoppable
     await service.start()
     return service
+
+
+class EmbeddingsPreprocessor(Operator):
+    """Tokenizes /v1/embeddings input (string / strings / token-id arrays)
+    into ``batch_token_ids`` for the EmbeddingEngine."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self.tokenizer = tokenizer
+
+    async def transform_request(self, request: dict, context: Context) -> dict:
+        inp = request.get("input")
+        if isinstance(inp, str):
+            batches = [self.tokenizer.encode(inp)]
+        elif inp and isinstance(inp[0], int):
+            batches = [list(inp)]
+        elif inp and isinstance(inp[0], list):
+            batches = [list(x) for x in inp]
+        else:
+            batches = [self.tokenizer.encode(s) for s in (inp or [])]
+        return {"batch_token_ids": batches, "model": request.get("model", "")}
+
+
+def build_embeddings_pipeline(tokenizer: Tokenizer, engine: AsyncEngine) -> AsyncEngine:
+    """Embeddings pipeline: tokenize → EmbeddingEngine (ref: ModelType::
+    Embedding engines behind /v1/embeddings, openai.rs:369)."""
+    return link([EmbeddingsPreprocessor(tokenizer)], engine)

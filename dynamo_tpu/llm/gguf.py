@@ -1,0 +1,156 @@
+"""GGUF metadata parsing (pure Python, read-only).
+
+Ref: lib/llm/src/gguf/ (~900 LoC) — the reference parses GGUF container
+metadata to build ModelDeploymentCards for llama.cpp models (context length,
+tokenizer, architecture). Same role here: read the header, metadata KV table
+and tensor directory without loading tensor data.
+
+Format (gguf v2/v3, little-endian):
+  magic "GGUF" | version u32 | tensor_count u64 | metadata_kv_count u64
+  kv: key(string) type(u32) value          string: len u64 + utf8 bytes
+  tensor: name(string) n_dims(u32) dims(u64 × n) ggml_type(u32) offset(u64)
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Dict, List, Optional
+
+GGUF_MAGIC = b"GGUF"
+
+# Metadata value types.
+T_UINT8, T_INT8, T_UINT16, T_INT16, T_UINT32, T_INT32 = 0, 1, 2, 3, 4, 5
+T_FLOAT32, T_BOOL, T_STRING, T_ARRAY, T_UINT64, T_INT64, T_FLOAT64 = 6, 7, 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    T_UINT8: "<B", T_INT8: "<b", T_UINT16: "<H", T_INT16: "<h",
+    T_UINT32: "<I", T_INT32: "<i", T_FLOAT32: "<f",
+    T_UINT64: "<Q", T_INT64: "<q", T_FLOAT64: "<d",
+}
+
+# ggml tensor dtypes we care to name (subset; unknown ids stay numeric).
+GGML_TYPE_NAMES = {
+    0: "f32", 1: "f16", 2: "q4_0", 3: "q4_1", 6: "q5_0", 7: "q5_1",
+    8: "q8_0", 9: "q8_1", 10: "q2_k", 11: "q3_k", 12: "q4_k", 13: "q5_k",
+    14: "q6_k", 15: "q8_k", 16: "iq2_xxs", 17: "iq2_xs", 18: "iq3_xxs",
+    24: "i8", 25: "i16", 26: "i32", 27: "i64", 28: "f64", 30: "bf16",
+}
+
+
+class GgufError(ValueError):
+    pass
+
+
+@dataclass
+class GgufTensorInfo:
+    name: str
+    shape: List[int]
+    ggml_type: int
+    offset: int
+
+    @property
+    def dtype_name(self) -> str:
+        return GGML_TYPE_NAMES.get(self.ggml_type, f"ggml_{self.ggml_type}")
+
+
+@dataclass
+class GgufMetadata:
+    version: int
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    tensors: List[GgufTensorInfo] = field(default_factory=list)
+
+    # --- convenience accessors the MDC builder uses -------------------------
+    @property
+    def architecture(self) -> Optional[str]:
+        return self.metadata.get("general.architecture")
+
+    @property
+    def model_name(self) -> Optional[str]:
+        return self.metadata.get("general.name")
+
+    def arch_field(self, suffix: str) -> Any:
+        """Read ``{arch}.{suffix}`` (e.g. context_length, block_count)."""
+        arch = self.architecture
+        return self.metadata.get(f"{arch}.{suffix}") if arch else None
+
+    @property
+    def context_length(self) -> Optional[int]:
+        return self.arch_field("context_length")
+
+    @property
+    def num_layers(self) -> Optional[int]:
+        return self.arch_field("block_count")
+
+    @property
+    def tokenizer_model(self) -> Optional[str]:
+        return self.metadata.get("tokenizer.ggml.model")
+
+    @property
+    def tokens(self) -> Optional[list]:
+        return self.metadata.get("tokenizer.ggml.tokens")
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return self.metadata.get("tokenizer.chat_template")
+
+
+def _read(f: BinaryIO, n: int) -> bytes:
+    data = f.read(n)
+    if len(data) != n:
+        raise GgufError(f"truncated GGUF file: wanted {n} bytes, got {len(data)}")
+    return data
+
+
+def _read_scalar(f: BinaryIO, fmt: str):
+    return struct.unpack(fmt, _read(f, struct.calcsize(fmt)))[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read_scalar(f, "<Q")
+    if n > 1 << 32:
+        raise GgufError(f"implausible string length {n}")
+    return _read(f, n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int, *, max_array: int):
+    if vtype in _SCALAR_FMT:
+        return _read_scalar(f, _SCALAR_FMT[vtype])
+    if vtype == T_BOOL:
+        return _read_scalar(f, "<B") != 0
+    if vtype == T_STRING:
+        return _read_string(f)
+    if vtype == T_ARRAY:
+        etype = _read_scalar(f, "<I")
+        count = _read_scalar(f, "<Q")
+        if count > max_array:
+            raise GgufError(f"array too large ({count} > {max_array})")
+        return [_read_value(f, etype, max_array=max_array) for _ in range(count)]
+    raise GgufError(f"unknown GGUF value type {vtype}")
+
+
+def parse_gguf(path: str, *, max_array: int = 1 << 24) -> GgufMetadata:
+    """Parse header + metadata + tensor directory (no tensor data reads)."""
+    with open(path, "rb") as f:
+        if _read(f, 4) != GGUF_MAGIC:
+            raise GgufError(f"{path}: not a GGUF file")
+        version = _read_scalar(f, "<I")
+        if version not in (2, 3):
+            raise GgufError(f"unsupported GGUF version {version}")
+        tensor_count = _read_scalar(f, "<Q")
+        kv_count = _read_scalar(f, "<Q")
+        meta = GgufMetadata(version=version)
+        for _ in range(kv_count):
+            key = _read_string(f)
+            vtype = _read_scalar(f, "<I")
+            meta.metadata[key] = _read_value(f, vtype, max_array=max_array)
+        for _ in range(tensor_count):
+            name = _read_string(f)
+            n_dims = _read_scalar(f, "<I")
+            if n_dims > 8:
+                raise GgufError(f"implausible tensor rank {n_dims}")
+            shape = [_read_scalar(f, "<Q") for _ in range(n_dims)]
+            ggml_type = _read_scalar(f, "<I")
+            offset = _read_scalar(f, "<Q")
+            meta.tensors.append(GgufTensorInfo(name=name, shape=shape, ggml_type=ggml_type, offset=offset))
+        return meta

@@ -74,6 +74,8 @@ class HttpService:
         app = web.Application()
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/responses", self.responses)
         app.router.add_get("/v1/models", self.list_models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics_route)
@@ -127,6 +129,117 @@ class HttpService:
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, kind="completions")
 
+    def _unary_envelope(self, model: str):
+        """Shared request lifecycle for unary JSON endpoints: inflight gauge,
+        duration histogram, status counters, structured 500 bodies."""
+
+        service = self
+
+        class _Scope:
+            async def __aenter__(self):
+                service._m_inflight(model).inc()
+                self.start = time.monotonic()
+                return self
+
+            async def __aexit__(self, exc_type, exc, tb):
+                service._m_inflight(model).dec()
+                service._m_duration(model).observe(time.monotonic() - self.start)
+                return False
+
+            def run(self, coro):
+                async def wrapped():
+                    try:
+                        resp = await coro()
+                        service._m_requests(model, "200").inc()
+                        return resp
+                    except oai.RequestError as e:
+                        service._m_requests(model, "400").inc()
+                        return web.json_response(oai.error_body(str(e)), status=400)
+                    except Exception as e:
+                        logger.exception("request for %s failed", model)
+                        service._m_requests(model, "500").inc()
+                        return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
+
+                return wrapped()
+
+        return _Scope()
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings (ref: openai.rs:369) — routed to an engine
+        registered under model_type 'embeddings'."""
+        try:
+            body = oai.validate_embedding_request(await request.json())
+        except (json.JSONDecodeError, oai.RequestError) as e:
+            return web.json_response(oai.error_body(str(e)), status=400)
+        model = body["model"]
+        engine = self.manager.get("embeddings", model)
+        if engine is None:
+            self._m_requests(model, "404").inc()
+            return web.json_response(
+                oai.error_body(f"no embeddings model {model!r}", "model_not_found", 404), status=404
+            )
+
+        async def handle():
+            vectors, prompt_tokens = [], 0
+            async for item in engine.generate(body, Context()):
+                if isinstance(item, Annotated) and item.is_annotation():
+                    continue
+                wire = item.data if isinstance(item, Annotated) else item
+                if isinstance(wire, dict) and "embeddings" in wire:
+                    vectors = wire["embeddings"]
+                    prompt_tokens = int(wire.get("prompt_tokens") or 0)
+            self._m_input_tokens(model).inc(prompt_tokens)
+            usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=0)
+            return web.json_response(oai.embedding_response(oai.make_id("embd"), model, vectors, usage))
+
+        async with self._unary_envelope(model) as scope:
+            return await scope.run(handle)
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """/v1/responses (ref: openai.rs:714) — mapped onto the chat
+        pipeline; input items are converted to chat messages."""
+        try:
+            body = oai.validate_responses_request(await request.json())
+        except (json.JSONDecodeError, oai.RequestError) as e:
+            return web.json_response(oai.error_body(str(e)), status=400)
+        model = body["model"]
+        engine = self.manager.get("chat", model)
+        if engine is None:
+            self._m_requests(model, "404").inc()
+            return web.json_response(oai.error_body(f"model {model!r} not found", "model_not_found", 404), status=404)
+        rid = oai.make_id("resp")
+
+        async def handle():
+            if body.get("stream"):
+                raise oai.RequestError("stream=true is not yet supported on /v1/responses")
+            chat_body = {
+                "model": model,
+                "messages": oai.responses_input_to_messages(body),  # RequestError on bad items
+                "stream": False,
+            }
+            for key in ("temperature", "top_p", "max_output_tokens"):
+                if body.get(key) is not None:
+                    chat_body["max_tokens" if key == "max_output_tokens" else key] = body[key]
+            text_parts, n_tokens, prompt_tokens = [], 0, 0
+            async for item in engine.generate(chat_body, Context()):
+                if isinstance(item, Annotated) and item.is_annotation():
+                    if item.event == "_metrics":
+                        prompt_tokens = int(item.comment or 0)
+                        self._m_input_tokens(model).inc(prompt_tokens)
+                    continue
+                out = _as_output(item)
+                if out is None:
+                    continue
+                if out.text:
+                    text_parts.append(out.text)
+                n_tokens += len(out.token_ids)
+            self._m_output_tokens(model).inc(n_tokens)
+            usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
+            return web.json_response(oai.responses_response(rid, model, "".join(text_parts), usage))
+
+        async with self._unary_envelope(model) as scope:
+            return await scope.run(handle)
+
     # --- core serving path --------------------------------------------------
     async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
         model = "unknown"
@@ -163,6 +276,8 @@ class HttpService:
 
     async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
         text_parts = []
+        reasoning_parts = []
+        tool_calls = None
         n_tokens = 0
         prompt_tokens = 0
         finish_reason = "stop"
@@ -182,6 +297,10 @@ class HttpService:
                         first_tok_at = time.monotonic()
                         self._m_ttft(model).observe(first_tok_at - start)
                     text_parts.append(out.text)
+                if out.reasoning:
+                    reasoning_parts.append(out.reasoning)
+                if out.tool_calls:
+                    tool_calls = out.tool_calls
                 n_tokens += len(out.token_ids)
                 if out.finish_reason:
                     finish_reason = out.finish_reason
@@ -194,7 +313,12 @@ class HttpService:
         usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
         text = "".join(text_parts)
         if kind == "chat":
-            return web.json_response(oai.chat_response(rid, model, text, finish_reason, usage))
+            return web.json_response(
+                oai.chat_response(
+                    rid, model, text, finish_reason, usage,
+                    tool_calls=tool_calls, reasoning="".join(reasoning_parts) or None,
+                )
+            )
         return web.json_response(oai.completion_response(rid, model, text, finish_reason, usage))
 
     async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
@@ -234,11 +358,19 @@ class HttpService:
                         self._m_itl(model).observe(now - prev_tok_at)
                     prev_tok_at = now
                     n_tokens += len(out.token_ids)
+                if out.reasoning and kind == "chat":
+                    await _sse(resp, oai.chat_chunk(rid, model, {"reasoning_content": out.reasoning}))
                 if out.text:
                     if kind == "chat":
                         await _sse(resp, oai.chat_chunk(rid, model, {"content": out.text}))
                     else:
                         await _sse(resp, oai.completion_chunk(rid, model, out.text))
+                if out.tool_calls and kind == "chat":
+                    delta_calls = [
+                        {**tc, "index": i, "function": tc["function"]}
+                        for i, tc in enumerate(out.tool_calls)
+                    ]
+                    await _sse(resp, oai.chat_chunk(rid, model, {"tool_calls": delta_calls}))
                 if out.finish_reason:
                     chunk = (
                         oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason)

@@ -24,6 +24,9 @@ class ModelDeploymentCard:
     context_length: int = 8192
     kv_cache_block_size: int = 16
     migration_limit: int = 0
+    # Output parsers (ref: parsers.rs registry names; None = defaults).
+    tool_call_parser: Optional[str] = None
+    reasoning_parser: Optional[str] = None
     runtime_config: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> bytes:

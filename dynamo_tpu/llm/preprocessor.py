@@ -70,10 +70,14 @@ class OpenAIPreprocessor(Operator):
         formatter: Optional[PromptFormatter] = None,
         *,
         default_max_tokens: int = 512,
+        tool_call_parser: Optional[str] = None,
+        reasoning_parser: Optional[str] = None,
     ):
         self.tokenizer = tokenizer
         self.formatter = formatter or PromptFormatter(getattr(tokenizer, "chat_template", None))
         self.default_max_tokens = default_max_tokens
+        self.tool_call_parser = tool_call_parser
+        self.reasoning_parser = reasoning_parser
 
     # --- Operator interface -------------------------------------------------
     async def transform_request(self, request: dict, context: Context) -> dict:
@@ -82,6 +86,14 @@ class OpenAIPreprocessor(Operator):
         wire["annotations"] = req.annotations
         # Side-band for the response annotation path; engines ignore it.
         wire["_formatted_prompt"] = prompt
+        # Output-parser directives for the Backend stage: the tool-call jail
+        # arms only when the request declares tools; reasoning splitting is a
+        # model property (ref: preprocessor.rs tool-call jail).
+        if (request.get("tools") and self.tool_call_parser is not None) or self.reasoning_parser:
+            wire["parser_options"] = {
+                "tool_call_parser": self.tool_call_parser if request.get("tools") else None,
+                "reasoning_parser": self.reasoning_parser,
+            }
         return wire
 
     def transform_response(self, stream: AsyncIterator, request: dict, context: Context) -> AsyncIterator:

@@ -93,6 +93,9 @@ class LLMEngineOutput:
     finish_reason: Optional[str] = None
     cum_log_probs: Optional[float] = None
     index: int = 0
+    # Set by the Backend parser stage on the final frame (OpenAI wire shape).
+    tool_calls: Optional[List[dict]] = None
+    reasoning: Optional[str] = None  # reasoning_content delta
 
     def to_wire(self) -> dict:
         d: Dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
@@ -102,6 +105,10 @@ class LLMEngineOutput:
             d["finish_reason"] = self.finish_reason
         if self.cum_log_probs is not None:
             d["cum_log_probs"] = self.cum_log_probs
+        if self.tool_calls is not None:
+            d["tool_calls"] = self.tool_calls
+        if self.reasoning is not None:
+            d["reasoning"] = self.reasoning
         return d
 
     @classmethod
@@ -112,4 +119,6 @@ class LLMEngineOutput:
             finish_reason=d.get("finish_reason"),
             cum_log_probs=d.get("cum_log_probs"),
             index=d.get("index", 0),
+            tool_calls=d.get("tool_calls"),
+            reasoning=d.get("reasoning"),
         )

@@ -117,7 +117,15 @@ def chat_response(
     text: str,
     finish_reason: str,
     usage: dict,
+    tool_calls: Optional[list] = None,
+    reasoning: Optional[str] = None,
 ) -> dict:
+    message: dict = {"role": "assistant", "content": text}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = text or None
+    if reasoning:
+        message["reasoning_content"] = reasoning
     return {
         "id": rid,
         "object": "chat.completion",
@@ -126,7 +134,7 @@ def chat_response(
         "choices": [
             {
                 "index": 0,
-                "message": {"role": "assistant", "content": text},
+                "message": message,
                 "finish_reason": finish_reason,
             }
         ],
@@ -165,3 +173,98 @@ def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
 
 def error_body(message: str, err_type: str = "invalid_request_error", code: int = 400) -> dict:
     return {"error": {"message": message, "type": err_type, "code": code}}
+
+
+# --- embeddings (ref: openai.rs:369, protocols/openai/embeddings) -----------
+
+
+def validate_embedding_request(body: dict) -> dict:
+    _require(isinstance(body, dict), "body must be a JSON object")
+    _require(bool(body.get("model")), "missing required field: model")
+    inp = body.get("input")
+    ok = isinstance(inp, str) or (
+        isinstance(inp, list)
+        and len(inp) > 0
+        and (
+            all(isinstance(x, str) for x in inp)
+            or all(isinstance(x, int) for x in inp)
+            or all(isinstance(x, list) and all(isinstance(t, int) for t in x) for x in inp)
+        )
+    )
+    _require(ok, "input must be a string, array of strings, or array(s) of token ids")
+    return body
+
+
+def embedding_response(rid: str, model: str, vectors: list, usage: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "list",
+        "model": model,
+        "data": [
+            {"object": "embedding", "index": i, "embedding": v} for i, v in enumerate(vectors)
+        ],
+        "usage": usage,
+    }
+
+
+# --- responses API (ref: openai.rs:714, protocols/openai/responses.rs) ------
+
+
+def validate_responses_request(body: dict) -> dict:
+    _require(isinstance(body, dict), "body must be a JSON object")
+    _require(bool(body.get("model")), "missing required field: model")
+    inp = body.get("input")
+    _require(
+        isinstance(inp, str) or (isinstance(inp, list) and len(inp) > 0),
+        "input must be a string or a non-empty array",
+    )
+    return body
+
+
+def responses_input_to_messages(body: dict) -> list:
+    """Convert Responses-API input (+ optional instructions) to chat
+    messages. Raises RequestError on malformed input items."""
+    messages = []
+    if body.get("instructions"):
+        messages.append({"role": "system", "content": body["instructions"]})
+    inp = body.get("input")
+    if isinstance(inp, str):
+        messages.append({"role": "user", "content": inp})
+        return messages
+    for item in inp:
+        if isinstance(item, str):
+            messages.append({"role": "user", "content": item})
+            continue
+        _require(isinstance(item, dict), "input items must be strings or objects")
+        role = item.get("role", "user")
+        content = item.get("content", "")
+        if isinstance(content, list):  # content parts → concatenated text
+            content = "".join(
+                p.get("text", "") for p in content if isinstance(p, dict) and p.get("type") in ("input_text", "output_text", "text")
+            )
+        messages.append({"role": role, "content": content})
+    return messages
+
+
+def responses_response(rid: str, model: str, text: str, usage: dict, status: str = "completed") -> dict:
+    return {
+        "id": rid,
+        "object": "response",
+        "created_at": int(time.time()),
+        "model": model,
+        "status": status,
+        "output": [
+            {
+                "type": "message",
+                "id": f"msg-{rid}",
+                "role": "assistant",
+                "status": status,
+                "content": [{"type": "output_text", "text": text, "annotations": []}],
+            }
+        ],
+        "usage": {
+            "input_tokens": usage.get("prompt_tokens", 0),
+            "output_tokens": usage.get("completion_tokens", 0),
+            "total_tokens": usage.get("total_tokens", 0),
+        },
+    }

@@ -1,0 +1,117 @@
+"""Logits processing: per-request processors applied before sampling.
+
+Ref: lib/bindings/python src/dynamo/logits_processing — ``BaseLogitsProcessor``
+protocol + example processors that engine adapters pass through to the
+engine. TPU twist: processors come in two flavors —
+
+- **Jit processors** (subclass :class:`JitLogitsProcessor`): pure functions
+  of (logits, generated-token history) that the scheduler folds into the
+  compiled sampling step. They must be shape-polymorphic-free jnp code.
+- **Host processors** (plain :class:`BaseLogitsProcessor`): arbitrary Python
+  run on the host between device steps (one device↔host sync per step —
+  fine for debugging/constrained decoding prototypes, not for the hot path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Protocol, Sequence, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+
+@runtime_checkable
+class BaseLogitsProcessor(Protocol):
+    """Protocol: called with the running token history and current logits,
+    returns adjusted logits (host-side, numpy/jax array in/out)."""
+
+    def __call__(self, token_ids: Sequence[int], logits: jax.Array) -> jax.Array:
+        ...
+
+
+class JitLogitsProcessor:
+    """A processor expressible in pure jnp over fixed shapes; the scheduler
+    can fuse it into the compiled decode step.
+
+    ``apply(logits, history, history_len)``: logits [V] f32, history [H] i32
+    (rolling window of generated ids, -1 padded), history_len scalar."""
+
+    def apply(self, logits: jax.Array, history: jax.Array, history_len: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+# --- example / stock processors --------------------------------------------
+
+
+@dataclass
+class TemperatureProcessor(JitLogitsProcessor):
+    temperature: float = 1.0
+
+    def apply(self, logits, history, history_len):
+        t = jnp.maximum(self.temperature, 1e-6)
+        return logits / t
+
+    def __call__(self, token_ids, logits):
+        return self.apply(logits, None, None)
+
+
+@dataclass
+class RepetitionPenaltyProcessor(JitLogitsProcessor):
+    """HF-style repetition penalty over the generated-token window:
+    seen tokens' logits are divided (if >0) or multiplied (if <0) by
+    ``penalty``."""
+
+    penalty: float = 1.1
+
+    def apply(self, logits, history, history_len):
+        V = logits.shape[-1]
+        hist = jnp.where(history >= 0, history, V)  # pad → out-of-range bucket
+        seen = jnp.zeros((V + 1,), dtype=bool).at[hist].set(True)[:V]
+        penalized = jnp.where(logits > 0, logits / self.penalty, logits * self.penalty)
+        return jnp.where(seen, penalized, logits)
+
+    def __call__(self, token_ids, logits):
+        hist = jnp.asarray(list(token_ids) or [-1], dtype=jnp.int32)
+        return self.apply(logits, hist, jnp.int32(len(token_ids)))
+
+
+@dataclass
+class MinPProcessor(JitLogitsProcessor):
+    """min-p: drop tokens whose probability < min_p * max_prob."""
+
+    min_p: float = 0.05
+
+    def apply(self, logits, history, history_len):
+        probs = jax.nn.softmax(logits, axis=-1)
+        cutoff = self.min_p * jnp.max(probs, axis=-1, keepdims=True)
+        return jnp.where(probs >= cutoff, logits, -jnp.inf)
+
+    def __call__(self, token_ids, logits):
+        return self.apply(logits, None, None)
+
+
+@dataclass
+class AllowedTokensProcessor(JitLogitsProcessor):
+    """Constrain sampling to an allow-list (the building block for
+    constrained/JSON decoding — the reference exposes the same example)."""
+
+    allowed: Sequence[int] = ()
+
+    def apply(self, logits, history, history_len):
+        V = logits.shape[-1]
+        mask = jnp.zeros((V,), dtype=bool).at[jnp.asarray(list(self.allowed), dtype=jnp.int32)].set(True)
+        return jnp.where(mask, logits, -jnp.inf)
+
+    def __call__(self, token_ids, logits):
+        return self.apply(logits, None, None)
+
+
+def apply_chain(
+    processors: List[BaseLogitsProcessor],
+    token_ids: Sequence[int],
+    logits: jax.Array,
+) -> jax.Array:
+    for proc in processors:
+        logits = proc(token_ids, logits)
+    return logits

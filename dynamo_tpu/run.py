@@ -65,6 +65,16 @@ async def amain(args) -> None:
     if args.mode == "http":
         manager = ModelManager()
         manager.add_model("chat", model_name, pipeline)
+        if isinstance(engine, TpuEngine):
+            from dynamo_tpu.engine.embeddings import EmbeddingEngine
+            from dynamo_tpu.llm.entrypoint import build_embeddings_pipeline
+
+            sched = engine.scheduler
+            manager.add_model(
+                "embeddings",
+                model_name,
+                build_embeddings_pipeline(tokenizer, EmbeddingEngine(sched.mc, sched.params)),
+            )
         service = HttpService(manager, host="0.0.0.0", port=args.http_port)
         await service.start()
         print(f"serving {model_name} on :{service.port} (POST /v1/chat/completions)", flush=True)

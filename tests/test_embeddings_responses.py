@@ -1,0 +1,122 @@
+"""/v1/embeddings + /v1/responses endpoint tests (ref: openai.rs:369,:714)."""
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.engine.embeddings import EmbeddingEngine
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.entrypoint import build_embeddings_pipeline, build_local_pipeline
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+import jax
+import jax.numpy as jnp
+
+MODEL = "tiny-embed"
+
+
+async def make_service():
+    cfg = get_config("tiny")
+    engine = TpuEngine.build(
+        EngineArgs(
+            model="tiny",
+            dtype="float32",
+            scheduler=SchedulerConfig(num_blocks=64, prefill_buckets=[16, 32, 64], decode_buckets=[1, 2, 4]),
+        )
+    )
+    tok = ByteTokenizer()
+    manager = ModelManager()
+    manager.add_model("chat", MODEL, build_local_pipeline(tok, engine))
+    manager.add_model(
+        "embeddings",
+        MODEL,
+        build_embeddings_pipeline(tok, EmbeddingEngine(cfg, engine.scheduler.params, buckets=[16, 32, 64])),
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service, engine
+
+
+def test_embed_fn_deterministic_and_normalized():
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ids = jnp.asarray(list(range(10, 26)), dtype=jnp.int32)
+    v1 = llama.embed(params, cfg, ids, jnp.int32(12))
+    v2 = llama.embed(params, cfg, ids, jnp.int32(12))
+    assert v1.shape == (cfg.hidden_size,)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+    assert abs(float(jnp.linalg.norm(v1)) - 1.0) < 1e-5
+    # Padding beyond valid_len must not change the embedding.
+    ids_padded = jnp.concatenate([ids[:12], jnp.full((20,), 99, dtype=jnp.int32)])
+    v3 = llama.embed(params, cfg, ids_padded, jnp.int32(12))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v3), rtol=1e-5, atol=1e-5)
+
+
+async def test_embeddings_endpoint():
+    service, engine = await make_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/embeddings"
+            body = {"model": MODEL, "input": ["hello world", "goodbye"]}
+            async with s.post(url, json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "list" and len(data["data"]) == 2
+                assert len(data["data"][0]["embedding"]) == 64  # tiny hidden
+                assert data["usage"]["prompt_tokens"] > 0
+            # Unknown model → 404.
+            async with s.post(url, json={"model": "nope", "input": "x"}) as r:
+                assert r.status == 404
+            # Bad input → 400.
+            async with s.post(url, json={"model": MODEL, "input": []}) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def test_responses_endpoint():
+    service, engine = await make_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/responses"
+            body = {
+                "model": MODEL,
+                "input": "say hi",
+                "instructions": "be terse",
+                "max_output_tokens": 5,
+            }
+            async with s.post(url, json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "response" and data["status"] == "completed"
+                msg = data["output"][0]
+                assert msg["role"] == "assistant"
+                assert msg["content"][0]["type"] == "output_text"
+                assert data["usage"]["output_tokens"] == 5
+            async with s.post(url, json={"model": MODEL}) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def test_responses_rejects_bad_items_and_stream():
+    service, engine = await make_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/responses"
+            # Malformed input item → structured 400, not a 500 crash.
+            async with s.post(url, json={"model": MODEL, "input": [42]}) as r:
+                assert r.status == 400
+                assert "error" in await r.json()
+            # stream=true → explicit 400 until SSE is implemented.
+            async with s.post(url, json={"model": MODEL, "input": "x", "stream": True}) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+        await engine.stop()

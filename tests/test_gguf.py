@@ -1,0 +1,84 @@
+"""GGUF parser tests against a synthetically written file (ref: gguf/ parsing
+role — metadata for llama.cpp model cards)."""
+
+import struct
+
+import pytest
+
+from dynamo_tpu.llm.gguf import GgufError, parse_gguf
+
+
+def _s(text: str) -> bytes:
+    b = text.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def write_gguf(path, *, version=3, metadata=(), tensors=()):
+    out = b"GGUF" + struct.pack("<IQQ", version, len(tensors), len(metadata))
+    for key, vtype, raw in metadata:
+        out += _s(key) + struct.pack("<I", vtype) + raw
+    for name, dims, gtype, offset in tensors:
+        out += _s(name) + struct.pack("<I", len(dims))
+        for d in dims:
+            out += struct.pack("<Q", d)
+        out += struct.pack("<IQ", gtype, offset)
+    path.write_bytes(out)
+
+
+def test_parse_metadata_and_tensors(tmp_path):
+    path = tmp_path / "m.gguf"
+    tokens_array = struct.pack("<IQ", 8, 2) + _s("<s>") + _s("</s>")  # array of strings
+    write_gguf(
+        path,
+        metadata=[
+            ("general.architecture", 8, _s("llama")),
+            ("general.name", 8, _s("tiny-test")),
+            ("llama.context_length", 4, struct.pack("<I", 4096)),
+            ("llama.block_count", 4, struct.pack("<I", 2)),
+            ("llama.rope.freq_base", 6, struct.pack("<f", 10000.0)),
+            ("tokenizer.ggml.model", 8, _s("gpt2")),
+            ("tokenizer.ggml.tokens", 9, tokens_array),
+            ("general.quantized", 7, b"\x01"),
+        ],
+        tensors=[
+            ("token_embd.weight", [256, 64], 0, 0),
+            ("blk.0.attn_q.weight", [64, 64], 30, 65536),
+        ],
+    )
+    meta = parse_gguf(str(path))
+    assert meta.version == 3
+    assert meta.architecture == "llama"
+    assert meta.model_name == "tiny-test"
+    assert meta.context_length == 4096
+    assert meta.num_layers == 2
+    assert meta.tokenizer_model == "gpt2"
+    assert meta.tokens == ["<s>", "</s>"]
+    assert meta.metadata["general.quantized"] is True
+    assert abs(meta.metadata["llama.rope.freq_base"] - 10000.0) < 1e-3
+    assert len(meta.tensors) == 2
+    t = meta.tensors[1]
+    assert t.name == "blk.0.attn_q.weight" and t.shape == [64, 64]
+    assert t.dtype_name == "bf16" and t.offset == 65536
+
+
+def test_rejects_bad_magic(tmp_path):
+    p = tmp_path / "bad.gguf"
+    p.write_bytes(b"NOPE" + b"\x00" * 100)
+    with pytest.raises(GgufError):
+        parse_gguf(str(p))
+
+
+def test_rejects_truncated(tmp_path):
+    p = tmp_path / "trunc.gguf"
+    write_gguf(p, metadata=[("general.architecture", 8, _s("llama"))])
+    data = p.read_bytes()
+    p.write_bytes(data[:-4])
+    with pytest.raises(GgufError):
+        parse_gguf(str(p))
+
+
+def test_rejects_unknown_version(tmp_path):
+    p = tmp_path / "v9.gguf"
+    write_gguf(p, version=9)
+    with pytest.raises(GgufError):
+        parse_gguf(str(p))

@@ -1,0 +1,90 @@
+"""Logits-processing tests: stock processors + engine integration
+(ref: dynamo.logits_processing examples)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig, StopConditions
+from dynamo_tpu.logits_processing import (
+    AllowedTokensProcessor,
+    MinPProcessor,
+    RepetitionPenaltyProcessor,
+    TemperatureProcessor,
+    apply_chain,
+)
+
+
+def test_repetition_penalty():
+    logits = jnp.array([2.0, -1.0, 0.5, 3.0])
+    proc = RepetitionPenaltyProcessor(penalty=2.0)
+    out = np.asarray(proc([0, 1], logits))
+    assert out[0] == 1.0  # positive → divided
+    assert out[1] == -2.0  # negative → multiplied
+    assert out[2] == 0.5 and out[3] == 3.0  # unseen untouched
+
+
+def test_allowed_tokens_masks_everything_else():
+    logits = jnp.zeros((10,))
+    out = np.asarray(AllowedTokensProcessor(allowed=[3, 7])([], logits))
+    kept = np.isfinite(out)
+    assert kept[3] and kept[7] and kept.sum() == 2
+
+
+def test_min_p():
+    logits = jnp.log(jnp.array([0.6, 0.3, 0.05, 0.05]))
+    out = np.asarray(MinPProcessor(min_p=0.2)([], logits))
+    assert np.isfinite(out[0]) and np.isfinite(out[1])
+    assert not np.isfinite(out[2]) and not np.isfinite(out[3])
+
+
+def test_chain_order():
+    logits = jnp.array([1.0, 2.0, 3.0])
+    out = apply_chain([TemperatureProcessor(2.0), AllowedTokensProcessor(allowed=[2])], [], logits)
+    out = np.asarray(out)
+    assert out[2] == 1.5 and not np.isfinite(out[0])
+
+
+def test_engine_respects_allowed_tokens():
+    """Greedy decode constrained to one token must emit only that token."""
+    import asyncio
+
+    async def run():
+        engine = TpuEngine.build(
+            EngineArgs(
+                model="tiny",
+                dtype="float32",
+                scheduler=SchedulerConfig(num_blocks=32, prefill_buckets=[16, 32], decode_buckets=[1, 2]),
+            )
+        )
+        try:
+            sched = engine.scheduler
+            seq = sched.add_request(
+                "r1",
+                list(range(10, 20)),
+                SamplingParams(temperature=0.0, logits_processors=[AllowedTokensProcessor(allowed=[42])]),
+                StopConditions(max_tokens=4),
+            )
+            import queue as _q
+
+            class Q:
+                def __init__(self):
+                    self.items = []
+
+                def put_nowait(self, x):
+                    self.items.append(x)
+
+            seq.out_queue = Q()
+            collected = []
+            for _ in range(8):
+                collected.extend(out for s, out in sched.step() if s is seq)
+                if collected and collected[-1].finished:
+                    break
+            toks = [o.token_id for o in collected if o.token_id >= 0]
+            assert toks == [42] * len(toks) and len(toks) == 4
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,280 @@
+"""Tool-call + reasoning parser tests (ref test shapes: lib/parsers/src/
+tool_calling/parsers.rs #[cfg(test)], reasoning/base_parser.rs)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.parsers import (
+    StreamingToolCallJail,
+    detect_tool_call_start,
+    get_available_reasoning_parsers,
+    get_available_tool_parsers,
+    get_reasoning_parser,
+    get_tool_parser,
+    try_tool_call_parse,
+)
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.runtime.engine import Annotated, Context
+
+
+# --- tool calling -----------------------------------------------------------
+
+
+def test_registry_names():
+    names = get_available_tool_parsers()
+    for expected in ("hermes", "llama3_json", "mistral", "nemotron_deci", "phi4",
+                     "pythonic", "harmony", "deepseek_v3_1", "default"):
+        assert expected in names
+    with pytest.raises(ValueError):
+        get_tool_parser("nope")
+
+
+def test_hermes_single_call():
+    calls, content = try_tool_call_parse(
+        'sure!\n<tool_call>\n{"name": "get_weather", "arguments": {"city": "SF"}}\n</tool_call>',
+        get_tool_parser("hermes"),
+    )
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+    assert content == "sure!"
+
+
+def test_hermes_parallel_calls():
+    text = (
+        '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+        '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>'
+    )
+    calls, content = try_tool_call_parse(text, get_tool_parser("hermes"))
+    assert [c.name for c in calls] == ["a", "b"]
+    assert content is None
+
+
+def test_hermes_no_bare_json():
+    calls, content = try_tool_call_parse('{"name": "a", "arguments": {}}', get_tool_parser("hermes"))
+    assert calls == [] and content is not None
+
+
+def test_mistral_array():
+    calls, _ = try_tool_call_parse(
+        '[TOOL_CALLS] [{"name": "f", "arguments": {"a": 2}}, {"name": "g", "arguments": {}}]',
+        get_tool_parser("mistral"),
+    )
+    assert [c.name for c in calls] == ["f", "g"]
+
+
+def test_llama3_json_python_tag():
+    calls, _ = try_tool_call_parse(
+        '<|python_tag|>{"name": "lookup", "parameters": {"q": "tpu"}}',
+        get_tool_parser("llama3_json"),
+    )
+    assert calls[0].name == "lookup"
+    assert json.loads(calls[0].arguments) == {"q": "tpu"}
+
+
+def test_nemotron_toolcall_wrapper():
+    calls, content = try_tool_call_parse(
+        'thinking done <TOOLCALL>[{"name": "calc", "arguments": {"expr": "1+1"}}]</TOOLCALL>',
+        get_tool_parser("nemotron_deci"),
+    )
+    assert calls[0].name == "calc"
+    assert content == "thinking done"
+
+
+def test_pythonic():
+    calls, content = try_tool_call_parse(
+        '[get_weather(city="SF", units="metric"), get_time(tz="PST")]',
+        get_tool_parser("pythonic"),
+    )
+    assert [c.name for c in calls] == ["get_weather", "get_time"]
+    assert json.loads(calls[0].arguments) == {"city": "SF", "units": "metric"}
+    assert content is None
+
+
+def test_pythonic_rejects_plain_list():
+    calls, content = try_tool_call_parse("[1, 2, 3]", get_tool_parser("pythonic"))
+    assert calls == [] and content == "[1, 2, 3]"
+
+
+def test_harmony_channels():
+    text = (
+        "<|channel|>analysis<|message|>user wants weather<|end|>"
+        '<|channel|>commentary to=functions.get_weather <|constrain|>json<|message|>{"city": "SF"}<|call|>'
+    )
+    calls, _ = try_tool_call_parse(text, get_tool_parser("harmony"))
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+
+
+def test_xml_invoke():
+    text = (
+        "<function_calls><invoke name=\"search\">"
+        "<parameter name=\"query\">tpu kernels</parameter>"
+        "<parameter name=\"limit\">5</parameter>"
+        "</invoke></function_calls>"
+    )
+    calls, _ = try_tool_call_parse(text, get_tool_parser("xml"))
+    assert calls[0].name == "search"
+    assert json.loads(calls[0].arguments) == {"query": "tpu kernels", "limit": 5}
+
+
+def test_typescript():
+    text = '<function_call>```typescript\nfunctions.get_current_weather({"location": "Shanghai"})\n```'
+    calls, _ = try_tool_call_parse(text, get_tool_parser("typescript"))
+    assert calls[0].name == "get_current_weather"
+
+
+def test_detect_start():
+    cfg = get_tool_parser("hermes")
+    assert detect_tool_call_start("<tool", cfg)  # marker prefix
+    assert detect_tool_call_start("<tool_call>{", cfg)
+    assert not detect_tool_call_start("hello", cfg)
+
+
+# --- reasoning --------------------------------------------------------------
+
+
+def test_reasoning_registry():
+    names = get_available_reasoning_parsers()
+    for expected in ("basic", "deepseek_r1", "qwen", "mistral", "kimi", "gpt_oss"):
+        assert expected in names
+
+
+def test_basic_reasoning_split():
+    p = get_reasoning_parser("basic")
+    r = p.parse("<think>step 1. step 2.</think>The answer is 4.")
+    assert r.reasoning == "step 1. step 2."
+    assert r.content == "The answer is 4."
+
+
+def test_deepseek_r1_starts_in_reasoning():
+    p = get_reasoning_parser("deepseek_r1")
+    r = p.parse("chain of thought here</think>final answer")
+    assert r.reasoning == "chain of thought here"
+    assert r.content == "final answer"
+
+
+def test_reasoning_truncated_stream():
+    p = get_reasoning_parser("basic")
+    r = p.parse("<think>never closed reasoning")
+    assert r.reasoning == "never closed reasoning"
+    assert r.content == ""
+
+
+def test_kimi_markers():
+    p = get_reasoning_parser("kimi")
+    r = p.parse("◁think▷hmm◁/think▷ok")
+    assert r.reasoning == "hmm" and r.content == "ok"
+
+
+def test_reasoning_streaming_marker_across_deltas():
+    p = get_reasoning_parser("basic")
+    chunks = ["<th", "ink>rea", "soning</th", "ink>con", "tent"]
+    reasoning = content = ""
+    for c in chunks:
+        r, t = p.feed(c)
+        reasoning += r
+        content += t
+    r, t = p.flush()
+    reasoning += r
+    content += t
+    assert reasoning == "reasoning"
+    assert content == "content"
+
+
+def test_gpt_oss_harmony_reasoning():
+    p = get_reasoning_parser("gpt_oss")
+    r = p.parse(
+        "<|channel|>analysis<|message|>let me think<|end|>"
+        "<|channel|>final<|message|>answer<|return|>"
+    )
+    assert r.reasoning == "let me think"
+    assert r.content == "answer"
+
+
+# --- streaming jail ---------------------------------------------------------
+
+
+def test_jail_passthrough_plain_text():
+    jail = StreamingToolCallJail(config=get_tool_parser("hermes"))
+    out = ""
+    for d in ["hello ", "world"]:
+        _, c = jail.feed(d)
+        out += c
+    _, tail, calls = jail.finish()
+    assert out + tail == "hello world" and calls == []
+
+
+def test_jail_captures_tool_call():
+    jail = StreamingToolCallJail(config=get_tool_parser("hermes"))
+    streamed = ""
+    for d in ["<tool_call>", '{"name": "f",', ' "arguments": {"x": 1}}', "</tool_call>"]:
+        _, c = jail.feed(d)
+        streamed += c
+    assert streamed == ""  # everything jailed
+    _, content, calls = jail.finish()
+    assert calls[0].name == "f" and content == ""
+
+
+def test_jail_releases_non_call():
+    # "<tool" prefix looks like a call start but never completes one.
+    jail = StreamingToolCallJail(config=get_tool_parser("hermes"))
+    _, c1 = jail.feed("<tool")
+    _, c2 = jail.feed("ing along>")
+    _, tail, calls = jail.finish()
+    assert calls == []
+    assert c1 + c2 + tail == "<tooling along>"
+
+
+# --- backend integration ----------------------------------------------------
+
+
+async def _drive_backend(frames, request):
+    backend = Backend(ByteTokenizer())
+
+    async def engine_stream():
+        for f in frames:
+            yield Annotated(data=f.to_wire())
+
+    out = []
+    async for item in backend.transform_response(engine_stream(), request, Context()):
+        if isinstance(item, Annotated) and not item.is_annotation():
+            out.append(LLMEngineOutput.from_wire(item.data))
+    return out
+
+
+async def test_backend_emits_tool_calls():
+    tok = ByteTokenizer()
+    payload = '<tool_call>{"name": "f", "arguments": {"x": 1}}</tool_call>'
+    ids = tok.encode(payload)
+    frames = [LLMEngineOutput(token_ids=ids[:4]), LLMEngineOutput(token_ids=ids[4:]),
+              LLMEngineOutput(finish_reason="stop")]
+    request = {
+        "stop_conditions": {},
+        "parser_options": {"tool_call_parser": "hermes", "reasoning_parser": None},
+    }
+    outs = await _drive_backend(frames, request)
+    final = outs[-1]
+    assert final.finish_reason == "tool_calls"
+    assert final.tool_calls and final.tool_calls[0]["function"]["name"] == "f"
+    # No text streamed for a pure tool-call response.
+    assert all(not o.text for o in outs)
+
+
+async def test_backend_reasoning_deltas():
+    tok = ByteTokenizer()
+    text = "<think>why</think>answer"
+    ids = tok.encode(text)
+    frames = [LLMEngineOutput(token_ids=ids), LLMEngineOutput(finish_reason="length")]
+    request = {
+        "stop_conditions": {},
+        "parser_options": {"tool_call_parser": None, "reasoning_parser": "basic"},
+    }
+    outs = await _drive_backend(frames, request)
+    reasoning = "".join(o.reasoning or "" for o in outs)
+    content = "".join(o.text or "" for o in outs)
+    assert reasoning == "why"
+    assert content == "answer"
