@@ -34,6 +34,15 @@ class ModelConfig:
     # MoE (0 experts = dense).
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # Architecture family: "llama" (GQA) or "mla" (DeepSeek-style multi-head
+    # latent attention — compressed KV latent cache).
+    architecture: str = "llama"
+    # MLA dims (ignored for llama): per-head nope/rope query dims, value dim,
+    # and the shared latent rank. Cache row = kv_lora_rank + qk_rope_head_dim.
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
     # Decode attention implementation: "auto" uses the Pallas paged-attention
     # kernel on TPU and the XLA gather path elsewhere; "gather"/"paged_kernel"
     # force one. (Static: picked at trace time, one executable per choice.)
@@ -116,6 +125,91 @@ PRESETS = {
         max_seq_len=131072,
         num_experts=128,
         num_experts_per_tok=4,
+    ),
+    # Tiny MLA config (DeepSeek-style latent attention) for unit tests.
+    "tiny-mla": ModelConfig(
+        name="tiny-mla",
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=1,
+        head_dim=16,
+        intermediate_size=128,
+        max_seq_len=256,
+        block_size=16,
+        rope_theta=10000.0,
+        architecture="mla",
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+    ),
+    # DeepSeek-V2-Lite (public specs): MLA + 64-expert MoE.
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        hidden_size=2048,
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=1,
+        head_dim=128,
+        intermediate_size=1408,
+        max_seq_len=32768,
+        rope_theta=10000.0,
+        architecture="mla",
+        kv_lora_rank=512,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        num_experts=64,
+        num_experts_per_tok=6,
+    ),
+    # DeepSeek-V3 / R1 (public specs): the wide-EP MLA decode target
+    # (ref recipe: components/backends/sglang slurm_jobs DeepSeek-R1).
+    "deepseek-v3": ModelConfig(
+        name="deepseek-v3",
+        vocab_size=129280,
+        hidden_size=7168,
+        num_layers=61,
+        num_heads=128,
+        num_kv_heads=1,
+        head_dim=128,
+        intermediate_size=2048,
+        max_seq_len=131072,
+        rope_theta=10000.0,
+        architecture="mla",
+        kv_lora_rank=512,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        num_experts=256,
+        num_experts_per_tok=8,
+    ),
+    # Llama-architecture aliases with their own dims.
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        intermediate_size=18944,
+        rope_theta=1000000.0,
+        max_seq_len=32768,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32768,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=1000000.0,
+        max_seq_len=32768,
     ),
     "llama-3.2-1b": ModelConfig(
         name="llama-3.2-1b",

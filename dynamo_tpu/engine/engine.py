@@ -89,8 +89,10 @@ class TpuEngine:
 
                 params = load_checkpoint(args.checkpoint_path, mc, dtype=dtype)
             else:
+                from dynamo_tpu.engine.models import get_module
+
                 logger.warning("no checkpoint: initializing random weights for %s", mc.name)
-                params = llama.init_params(mc, jax.random.PRNGKey(args.seed), dtype=dtype)
+                params = get_module(mc).init_params(mc, jax.random.PRNGKey(args.seed), dtype=dtype)
         engine = cls(
             Scheduler(
                 mc,

@@ -46,6 +46,16 @@ class KvCacheArrays:
         dtype=jnp.bfloat16,
         sharding: Optional[jax.sharding.Sharding] = None,
     ) -> "KvCacheArrays":
+        if config.architecture == "mla":
+            # MLA stores one shared latent row per token (kv_lora_rank +
+            # rope dim) in ``k``; ``v`` is a placeholder (values decompress
+            # from the latent — models/mla.py).
+            width = config.kv_lora_rank + config.qk_rope_head_dim
+            shape = (config.num_layers, num_blocks, config.block_size, 1, width)
+            k = jnp.zeros(shape, dtype=dtype)
+            if sharding is not None:
+                k = jax.device_put(k, sharding)
+            return cls(k=k, v=jnp.zeros((config.num_layers, 1, 1, 1, 1), dtype=dtype))
         shape = (config.num_layers, num_blocks, config.block_size, config.num_kv_heads, config.head_dim)
         init = jnp.zeros(shape, dtype=dtype)
         if sharding is not None:

@@ -161,8 +161,11 @@ def prefill(
     valid_len: jax.Array,  # scalar: actual new tokens
     cache_len: jax.Array,  # scalar: tokens already in the block table (prefix reuse / chunked prefill)
     block_table: jax.Array,  # [max_blocks] block ids (0 = scratch)
+    all_logits: bool = False,  # static: return logits for every position [T, V]
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One prefill (or prefill chunk). Returns (last_logits [V], k_cache, v_cache)."""
+    """One prefill (or prefill chunk). Returns (last_logits [V], k_cache,
+    v_cache) — or ([T, V] logits with ``all_logits=True``, the target-model
+    verification pass for speculative decoding; spec_decode.py)."""
     c = config
     bs = c.block_size
     T = tokens.shape[0]
@@ -206,9 +209,13 @@ def prefill(
 
     h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
 
+    head = params.get("lm_head")
+    if all_logits:
+        h_all = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+        logits = h_all @ (head if head is not None else params["embed"].T)
+        return logits.astype(jnp.float32), k_new, v_new
     last = jnp.maximum(valid_len - 1, 0)
     h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
-    head = params.get("lm_head")
     logits = h_last @ (head if head is not None else params["embed"].T)
     return logits.astype(jnp.float32), k_new, v_new
 

@@ -1,0 +1,219 @@
+"""Multi-head latent attention (MLA, DeepSeek-V2/V3 family) over a paged
+*latent* KV cache.
+
+The reference serves DeepSeek models through engine adapters (SGLang
+DP-attention / TRT-LLM wide-EP recipes, SURVEY.md §2e); here MLA is native.
+TPU-first design:
+
+- **Latent cache**: each token stores one row ``[kv_lora_rank + rope_dim]``
+  (e.g. 512+64) instead of per-head K/V — ~7× less HBM than GQA-8 at
+  head_dim 128, which multiplies the decode batch the HBM can hold.
+- **Absorbed projections**: queries are pre-multiplied by W_uk
+  (``q_eff = q_nope · W_uk``) so attention contracts directly against the
+  latent; values decompress *after* the probability-weighted latent sum
+  (``out = (p · c_kv) · W_uv``) — both are MXU matmuls, nothing per-key.
+- Same paged block-table layout as the llama family (block 0 = scratch
+  sink), so the scheduler, prefix cache, KVBM and disaggregation move MLA
+  blocks with zero special-casing.
+
+Cache layout: k_cache [L, N, BS, 1, R] with R = kv_lora_rank +
+qk_rope_head_dim (the "1" keeps the [L, N, BS, heads, dim] rank the rest of
+the stack expects); v_cache is unused (shape [L, 1, 1, 1, 1]).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.models.llama import _mlp, apply_rope, rms_norm
+
+Params = Dict[str, jax.Array]
+
+
+def latent_width(config: ModelConfig) -> int:
+    return config.kv_lora_rank + config.qk_rope_head_dim
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    c = config
+    L, H = c.num_layers, c.num_heads
+    qk = c.qk_nope_head_dim + c.qk_rope_head_dim
+    keys = jax.random.split(key, 12)
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5 if len(shape) >= 2 else 0.02
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    layers: Dict[str, jax.Array] = {
+        "attn_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+        "mlp_norm": jnp.ones((L, c.hidden_size), dtype=dtype),
+        "kv_norm": jnp.ones((L, c.kv_lora_rank), dtype=dtype),
+        "wq": dense(keys[0], (L, c.hidden_size, H * qk)),
+        "w_dkv": dense(keys[1], (L, c.hidden_size, c.kv_lora_rank)),
+        "w_kr": dense(keys[2], (L, c.hidden_size, c.qk_rope_head_dim)),
+        "w_uk": dense(keys[3], (L, H, c.qk_nope_head_dim, c.kv_lora_rank), scale=c.qk_nope_head_dim**-0.5),
+        "w_uv": dense(keys[4], (L, H, c.kv_lora_rank, c.v_head_dim), scale=c.kv_lora_rank**-0.5),
+        "wo": dense(keys[5], (L, H * c.v_head_dim, c.hidden_size)),
+    }
+    if c.num_experts == 0:
+        layers.update(
+            w_gate=dense(keys[6], (L, c.hidden_size, c.intermediate_size)),
+            w_up=dense(keys[7], (L, c.hidden_size, c.intermediate_size)),
+            w_down=dense(keys[8], (L, c.intermediate_size, c.hidden_size)),
+        )
+    else:
+        E = c.num_experts
+        layers.update(
+            router=dense(keys[9], (L, c.hidden_size, E)),
+            w_gate=dense(keys[6], (L, E, c.hidden_size, c.intermediate_size)),
+            w_up=dense(keys[7], (L, E, c.hidden_size, c.intermediate_size)),
+            w_down=dense(keys[8], (L, E, c.intermediate_size, c.hidden_size)),
+        )
+    params: Params = {
+        "embed": dense(keys[10], (c.vocab_size, c.hidden_size), scale=0.02),
+        "final_norm": jnp.ones((c.hidden_size,), dtype=dtype),
+        "layers": layers,
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = dense(keys[11], (c.hidden_size, c.vocab_size), scale=0.02)
+    return params
+
+
+def _project_q(x: jax.Array, lp, c: ModelConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """x [T, D] → (q_eff [T, H, r], q_rope [T, H, rope]) with q_eff absorbed
+    through W_uk."""
+    T = x.shape[0]
+    qk = c.qk_nope_head_dim + c.qk_rope_head_dim
+    q = (x @ lp["wq"]).reshape(T, c.num_heads, qk)
+    q_nope = q[..., : c.qk_nope_head_dim]
+    q_rope = apply_rope(q[..., c.qk_nope_head_dim :], positions, c.rope_theta)
+    q_eff = jnp.einsum("thn,hnr->thr", q_nope, lp["w_uk"])  # absorb W_uk
+    return q_eff, q_rope
+
+
+def _latent_kv(x: jax.Array, lp, c: ModelConfig, positions: jax.Array) -> jax.Array:
+    """x [T, D] → latent rows [T, R] = [norm(c_kv) ‖ rope(k_rope)]."""
+    c_kv = rms_norm(x @ lp["w_dkv"], lp["kv_norm"], c.rms_norm_eps)
+    k_rope = apply_rope((x @ lp["w_kr"])[:, None, :], positions, c.rope_theta)[:, 0]
+    return jnp.concatenate([c_kv, k_rope], axis=-1)
+
+
+def _attend_latent(
+    q_eff: jax.Array,  # [T, H, r]
+    q_rope: jax.Array,  # [T, H, rope]
+    latent: jax.Array,  # [S, R]
+    mask: jax.Array,  # [T, S]
+    lp,
+    c: ModelConfig,
+) -> jax.Array:
+    """→ [T, H * v_head_dim]."""
+    r = c.kv_lora_rank
+    c_kv, k_rope = latent[:, :r], latent[:, r:]
+    scale = (c.qk_nope_head_dim + c.qk_rope_head_dim) ** -0.5
+    scores = (
+        jnp.einsum("thr,sr->ths", q_eff, c_kv) + jnp.einsum("the,se->ths", q_rope, k_rope)
+    ).astype(jnp.float32) * scale
+    scores = jnp.where(mask[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q_eff.dtype)
+    attn_lat = jnp.einsum("ths,sr->thr", probs, c_kv)  # weighted latent sum
+    out = jnp.einsum("thr,hrv->thv", attn_lat, lp["w_uv"])  # decompress once
+    return out.reshape(q_eff.shape[0], c.num_heads * c.v_head_dim)
+
+
+def prefill(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, 1, R]
+    v_cache: jax.Array,  # unused
+    tokens: jax.Array,  # [T]
+    valid_len: jax.Array,
+    cache_len: jax.Array,
+    block_table: jax.Array,  # [max_blocks]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    c = config
+    bs = c.block_size
+    T = tokens.shape[0]
+    ctx = block_table.shape[0] * bs
+
+    h = params["embed"].at[tokens].get(mode="clip")
+    positions = cache_len + jnp.arange(T, dtype=jnp.int32)
+    valid_q = jnp.arange(T, dtype=jnp.int32) < valid_len
+    slots = jnp.where(valid_q, positions, 0)
+    tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)
+    tgt_offs = slots % bs
+
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    total = cache_len + valid_len
+    mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)
+
+    def layer_fn(h, xs):
+        lp, kc = xs  # kc [N, BS, 1, R]
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q_eff, q_rope = _project_q(x, lp, c, positions)
+        latent_new = _latent_kv(x, lp, c, positions)  # [T, R]
+        kc = kc.at[tgt_blocks, tgt_offs, 0].set(latent_new)
+        latent_ctx = kc[block_table].reshape(ctx, latent_width(c))
+        attn = _attend_latent(q_eff, q_rope, latent_ctx, mask, lp, c)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        h = h + _mlp(x, lp, c)
+        return h, kc
+
+    h, k_new = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    last = jnp.maximum(valid_len - 1, 0)
+    h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = h_last @ (head if head is not None else params["embed"].T)
+    return logits.astype(jnp.float32), k_new, v_cache
+
+
+def decode(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, 1, R]
+    v_cache: jax.Array,  # unused
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    block_tables: jax.Array,  # [B, W]
+    active: jax.Array,  # [B]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    c = config
+    bs = c.block_size
+    B = tokens.shape[0]
+    ctx = block_tables.shape[1] * bs
+    R = latent_width(c)
+
+    h = params["embed"].at[tokens].get(mode="clip")
+    slots = jnp.where(active, positions, 0)
+    tgt_blocks = jnp.where(active, jnp.take_along_axis(block_tables, (slots // bs)[:, None], axis=1)[:, 0], 0)
+    tgt_offs = slots % bs
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    mask = key_pos[None, :] <= positions[:, None]
+
+    def layer_fn(h, xs):
+        lp, kc = xs
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        # dim 0 is the batch here; rope broadcasts per-row positions the same
+        # way it broadcasts per-token positions in prefill.
+        q_eff, q_rope = _project_q(x, lp, c, positions)
+        latent_row = _latent_kv(x, lp, c, positions)  # [B, R]
+        kc = kc.at[tgt_blocks, tgt_offs, 0].set(latent_row)
+        latent_ctx = kc[block_tables].reshape(B, ctx, R)
+        attn = jax.vmap(
+            lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
+        )(q_eff, q_rope, latent_ctx, mask)  # [B, H*v]
+        h = h + attn @ lp["wo"]
+        x2 = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        h = h + _mlp(x2, lp, c)
+        return h, kc
+
+    h, k_new = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = h @ (head if head is not None else params["embed"].T)
+    return logits.astype(jnp.float32), k_new, v_cache

@@ -187,12 +187,15 @@ class Scheduler:
             model_config.max_seq_len
         ]
 
+        from dynamo_tpu.engine.models import get_module
+
+        model = get_module(model_config)
         self._prefill_jit = jax.jit(
-            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, self.mc, k, v, t, vl, cl, bt),
+            lambda p, k, v, t, vl, cl, bt: model.prefill(p, self.mc, k, v, t, vl, cl, bt),
             donate_argnums=(1, 2),
         )
         self._decode_jit = jax.jit(
-            lambda p, k, v, t, pos, bt, act: llama.decode(p, self.mc, k, v, t, pos, bt, act),
+            lambda p, k, v, t, pos, bt, act: model.decode(p, self.mc, k, v, t, pos, bt, act),
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)

@@ -1,0 +1,188 @@
+"""Speculative decoding: draft-model proposal + one-pass target verification.
+
+The reference surfaces engine-side speculation through SpecDecodeStats
+(lib/bindings/python _core.pyi:354-427 ForwardPassMetrics); the engines do
+the speculating. Here it is native: a small draft model proposes ``gamma``
+tokens greedily, the target scores all of them in ONE batched forward
+(``prefill(..., all_logits=True)`` — MXU-friendly: the verify pass turns γ
+sequential decode steps into one γ-token matmul pass), and the longest
+agreeing prefix is accepted plus one bonus/correction token from the target
+distribution.
+
+Greedy acceptance (temperature 0): accepted_i ⇔ draft_i == target_argmax_i.
+Per round the target advances by k+1 tokens (k accepted + bonus) for one
+target forward — the speedup when draft agreement is high.
+
+Cache bookkeeping: proposals are written into both paged caches as they are
+produced; rejected slots hold stale rows but are position-masked until the
+real token at that position overwrites them (write-before-attend, monotone
+positions), so no rollback pass is needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.scheduler import next_bucket
+
+
+@dataclass
+class SpecDecodeStats:
+    """Ref: _core.pyi SpecDecodeStats — acceptance accounting."""
+
+    num_spec_tokens: int = 0  # total proposed
+    num_accepted_tokens: int = 0
+    num_draft_tokens: int = 0
+    num_rounds: int = 0
+    # Per-position acceptance counts (how often position i of a proposal run
+    # was accepted) — the reference exposes the same shape.
+    accepted_per_position: List[int] = field(default_factory=list)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.num_accepted_tokens / self.num_draft_tokens if self.num_draft_tokens else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "num_spec_tokens": self.num_spec_tokens,
+            "num_accepted_tokens": self.num_accepted_tokens,
+            "num_draft_tokens": self.num_draft_tokens,
+            "num_rounds": self.num_rounds,
+            "acceptance_rate": round(self.acceptance_rate, 4),
+            "accepted_per_position": self.accepted_per_position,
+        }
+
+
+class SpecDecoder:
+    """Greedy speculative generation over two llama-family models sharing a
+    tokenizer/vocab. Self-contained paged caches (not the serving scheduler's
+    pool) — the serving integration point is one sequence at a time."""
+
+    def __init__(
+        self,
+        target_config: ModelConfig,
+        target_params,
+        draft_config: ModelConfig,
+        draft_params,
+        *,
+        gamma: int = 4,
+        dtype=jnp.float32,
+    ):
+        if target_config.block_size != draft_config.block_size:
+            raise ValueError("target and draft must share block_size")
+        if target_config.vocab_size != draft_config.vocab_size:
+            raise ValueError("target and draft must share the vocabulary")
+        self.tc, self.dc = target_config, draft_config
+        self.tp, self.dp = target_params, draft_params
+        self.gamma = gamma
+        self.dtype = dtype
+
+        self._t_prefill = jax.jit(
+            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, self.tc, k, v, t, vl, cl, bt),
+            donate_argnums=(1, 2),
+        )
+        self._t_verify = jax.jit(
+            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, self.tc, k, v, t, vl, cl, bt, all_logits=True),
+            donate_argnums=(1, 2),
+        )
+        self._d_prefill = jax.jit(
+            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, self.dc, k, v, t, vl, cl, bt),
+            donate_argnums=(1, 2),
+        )
+        self._d_decode = jax.jit(
+            lambda p, k, v, t, pos, bt, act: llama.decode(p, self.dc, k, v, t, pos, bt, act),
+            donate_argnums=(1, 2),
+        )
+
+    def generate(
+        self,
+        prompt: List[int],
+        max_tokens: int,
+        *,
+        eos_token_ids: Optional[List[int]] = None,
+        stats: Optional[SpecDecodeStats] = None,
+    ) -> List[int]:
+        """Greedy generation; returns generated token ids (≤ max_tokens)."""
+        eos = set(eos_token_ids or [])
+        total_len = len(prompt) + max_tokens + self.gamma + 2
+        bs = self.tc.block_size
+        n_blocks = (total_len + bs - 1) // bs
+        table = jnp.arange(1, 1 + n_blocks, dtype=jnp.int32)
+        t_cache = KvCacheArrays.create(self.tc, n_blocks + 1, dtype=self.dtype)
+        d_cache = KvCacheArrays.create(self.dc, n_blocks + 1, dtype=self.dtype)
+
+        buckets = [32, 64, 128, 256, 512, 1024, 2048]
+        T = len(prompt)
+        bucket = next_bucket(T, buckets)
+        padded = jnp.zeros((bucket,), dtype=jnp.int32).at[:T].set(jnp.asarray(prompt, dtype=jnp.int32))
+
+        t_logits, t_cache.k, t_cache.v = self._t_prefill(
+            self.tp, t_cache.k, t_cache.v, padded, jnp.int32(T), jnp.int32(0), table
+        )
+        _, d_cache.k, d_cache.v = self._d_prefill(
+            self.dp, d_cache.k, d_cache.v, padded, jnp.int32(T), jnp.int32(0), table
+        )
+
+        out: List[int] = [int(jnp.argmax(t_logits))]  # first target token
+        n = T  # tokens materialized in the target cache
+        verify_bucket = 1 << (self.gamma + 1 - 1).bit_length()
+
+        while len(out) < max_tokens and out[-1] not in eos:
+            b = out[-1]  # last confirmed token, not yet in either cache
+            # --- draft proposes gamma tokens (sequential small decodes) ----
+            proposals: List[int] = []
+            tok, pos = b, n
+            for _ in range(self.gamma):
+                logits, d_cache.k, d_cache.v = self._d_decode(
+                    self.dp, d_cache.k, d_cache.v,
+                    jnp.asarray([tok], dtype=jnp.int32),
+                    jnp.asarray([pos], dtype=jnp.int32),
+                    table[None, :],
+                    jnp.ones((1,), dtype=bool),
+                )
+                tok = int(jnp.argmax(logits[0]))
+                proposals.append(tok)
+                pos += 1
+
+            # --- target verifies [b, x1..xγ] in one pass -------------------
+            chunk = [b] + proposals
+            padded_c = jnp.zeros((verify_bucket,), dtype=jnp.int32).at[: len(chunk)].set(
+                jnp.asarray(chunk, dtype=jnp.int32)
+            )
+            logits_all, t_cache.k, t_cache.v = self._t_verify(
+                self.tp, t_cache.k, t_cache.v, padded_c, jnp.int32(len(chunk)), jnp.int32(n), table
+            )
+            preds = np.asarray(jnp.argmax(logits_all[: len(chunk)], axis=-1))
+            # preds[i] = target's token after consuming chunk[:i+1].
+            k = 0
+            while k < self.gamma and proposals[k] == int(preds[k]):
+                k += 1
+            accepted = proposals[:k]
+            bonus = int(preds[k])  # correction (k<γ) or extension (k==γ)
+
+            if stats is not None:
+                stats.num_rounds += 1
+                stats.num_draft_tokens += self.gamma
+                stats.num_spec_tokens += self.gamma
+                stats.num_accepted_tokens += k
+                while len(stats.accepted_per_position) < self.gamma:
+                    stats.accepted_per_position.append(0)
+                for i in range(k):
+                    stats.accepted_per_position[i] += 1
+
+            # Emit accepted + bonus, honoring eos/max_tokens.
+            for t in accepted:
+                out.append(t)
+                if len(out) >= max_tokens or t in eos:
+                    return out[:max_tokens]
+            out.append(bonus)
+            n += 1 + k  # b plus accepted proposals are now target-cache-valid
+        return out[:max_tokens]

@@ -29,12 +29,34 @@ def _scatter(k_cache: jax.Array, v_cache: jax.Array, block_id: jax.Array, k: jax
     return k_cache.at[:, block_id].set(k), v_cache.at[:, block_id].set(v)
 
 
+def _has_v(cache: KvCacheArrays) -> bool:
+    # MLA caches carry everything in the latent ``k`` array; ``v`` is a
+    # [L,1,1,1,1] placeholder that must not be block-indexed.
+    return cache.v.shape[1:] == cache.k.shape[1:]
+
+
 def gather_blocks(cache: KvCacheArrays, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
     """Device block → host numpy (device_get performs the DMA)."""
+    if not _has_v(cache):
+        k_dev = _gather_k(cache.k, jnp.int32(block_id))
+        return np.asarray(jax.device_get(k_dev)), np.zeros((0,), dtype=cache.k.dtype)
     k_dev, v_dev = _gather(cache.k, cache.v, jnp.int32(block_id))
     return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
 
 
 def scatter_blocks(cache: KvCacheArrays, block_id: int, k: np.ndarray, v: np.ndarray) -> None:
     """Host numpy → device block (in-place on the cache handle)."""
+    if not _has_v(cache):
+        cache.k = _scatter_k(cache.k, jnp.int32(block_id), jnp.asarray(k))
+        return
     cache.k, cache.v = _scatter(cache.k, cache.v, jnp.int32(block_id), jnp.asarray(k), jnp.asarray(v))
+
+
+@jax.jit
+def _gather_k(k_cache: jax.Array, block_id: jax.Array) -> jax.Array:
+    return k_cache[:, block_id]
+
+
+@jax.jit
+def _scatter_k(k_cache: jax.Array, block_id: jax.Array, k: jax.Array) -> jax.Array:
+    return k_cache.at[:, block_id].set(k)
