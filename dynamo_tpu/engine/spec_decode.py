@@ -133,14 +133,30 @@ class SpecDecoder:
 
         out: List[int] = [int(jnp.argmax(t_logits))]  # first target token
         n = T  # tokens materialized in the target cache
+        d_n = T  # tokens materialized in the draft cache (may lag n)
         verify_bucket = 1 << (self.gamma + 1 - 1).bit_length()
 
         while len(out) < max_tokens and out[-1] not in eos:
             b = out[-1]  # last confirmed token, not yet in either cache
-            # --- draft proposes gamma tokens (sequential small decodes) ----
+            # --- draft catches up on confirmed tokens it hasn't consumed,
+            # then proposes gamma tokens (sequential small decodes).
+            # Confirmed token at position T+i is out[i]; the catch-up feeds
+            # positions d_n..n (the last one is b) so the draft cache is
+            # coherent with the target's accepted prefix before proposing.
             proposals: List[int] = []
-            tok, pos = b, n
-            for _ in range(self.gamma):
+            logits = None
+            for pos in range(d_n, n + 1):
+                logits, d_cache.k, d_cache.v = self._d_decode(
+                    self.dp, d_cache.k, d_cache.v,
+                    jnp.asarray([out[pos - T]], dtype=jnp.int32),
+                    jnp.asarray([pos], dtype=jnp.int32),
+                    table[None, :],
+                    jnp.ones((1,), dtype=bool),
+                )
+            tok = int(jnp.argmax(logits[0]))
+            proposals.append(tok)
+            pos = n + 1
+            for _ in range(self.gamma - 1):
                 logits, d_cache.k, d_cache.v = self._d_decode(
                     self.dp, d_cache.k, d_cache.v,
                     jnp.asarray([tok], dtype=jnp.int32),
@@ -184,5 +200,12 @@ class SpecDecoder:
                 if len(out) >= max_tokens or t in eos:
                     return out[:max_tokens]
             out.append(bonus)
+            old_n = n
             n += 1 + k  # b plus accepted proposals are now target-cache-valid
+            # Draft consumed b + proposals[:γ-1] this round; only the
+            # confirmed prefix (b + accepted[:min(k,γ-1)]) is coherent —
+            # stale rows beyond it get overwritten by the next catch-up
+            # before they are attended to. Absolute, not incremental: the
+            # catch-up loop re-materialized everything through old_n.
+            d_n = old_n + 1 + min(k, self.gamma - 1)
         return out[:max_tokens]
