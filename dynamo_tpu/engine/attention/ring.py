@@ -63,11 +63,11 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool, scale: flo
     qg = q.reshape(T, KVH, G, hd)
     q_offset = idx * T
 
-    # Online-softmax accumulators (pvary: the loop makes them device-varying,
+    # Online-softmax accumulators (pcast-to-varying: the loop makes them device-varying,
     # so the carry must start that way for shard_map's type system).
-    m_acc = lax.pvary(jnp.full((KVH, T, G), NEG_INF, dtype=jnp.float32), axis_name)
-    l_acc = lax.pvary(jnp.zeros((KVH, T, G), dtype=jnp.float32), axis_name)
-    o_acc = lax.pvary(jnp.zeros((KVH, T, G, hd), dtype=jnp.float32), axis_name)
+    m_acc = lax.pcast(jnp.full((KVH, T, G), NEG_INF, dtype=jnp.float32), axis_name, to="varying")
+    l_acc = lax.pcast(jnp.zeros((KVH, T, G), dtype=jnp.float32), axis_name, to="varying")
+    o_acc = lax.pcast(jnp.zeros((KVH, T, G, hd), dtype=jnp.float32), axis_name, to="varying")
 
     def body(r, carry):
         m_acc, l_acc, o_acc, k_cur, v_cur = carry

@@ -259,45 +259,48 @@ def embed(
 # ---------------------------------------------------------------------------
 
 
-def decode(
-    params: Params,
-    config: ModelConfig,
-    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
-    v_cache: jax.Array,
-    tokens: jax.Array,  # [B] current token per sequence
-    positions: jax.Array,  # [B] position of each token (its write slot)
+def decode_targets(
+    positions: jax.Array,  # [B]
     block_tables: jax.Array,  # [B, max_blocks]
-    active: jax.Array,  # [B] bool — padded batch slots are False
+    active: jax.Array,  # [B] bool
+    block_size: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decode step for a batch. Returns (logits [B, V], k_cache, v_cache)."""
-    c = config
-    bs = c.block_size
-    B = tokens.shape[0]
-    ctx = block_tables.shape[1] * bs
+    """Paged-KV scatter targets + causal context mask for one decode step.
 
-    h = params["embed"].at[tokens].get(mode="clip")  # [B, D]
-
+    Inactive rows sink to scratch block 0 (never allocated). Returns
+    (tgt_blocks [B], tgt_offs [B], mask [B, ctx]). Shared by ``decode`` and
+    the pipelined path so the addressing convention lives in one place."""
     slots = jnp.where(active, positions, 0)
-    tgt_blocks = jnp.where(active, jnp.take_along_axis(block_tables, (slots // bs)[:, None], axis=1)[:, 0], 0)
-    tgt_offs = slots % bs
-
-    # "auto" only picks the kernel single-chip (under a GSPMD mesh the
-    # pallas_call would need a shard_map wrapper; the gather path partitions
-    # fine) and only when KV pages are Mosaic-DMA-aligned: lane dim
-    # KVH*HD % 128, sublane BS % 8 (tiny test configs fall back to gather).
-    aligned = (c.kv_size % 128 == 0) and (c.block_size % 8 == 0)
-    on_tpu = jax.default_backend() == "tpu"
-    use_kernel = c.attention_impl == "paged_kernel" or (
-        c.attention_impl == "auto" and aligned and on_tpu and jax.device_count() == 1
+    tgt_blocks = jnp.where(
+        active, jnp.take_along_axis(block_tables, (slots // block_size)[:, None], axis=1)[:, 0], 0
     )
-    if c.attention_impl == "paged_kernel" and on_tpu and not aligned:
-        raise ValueError(
-            f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
-            f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
-        )
+    tgt_offs = slots % block_size
+    ctx = block_tables.shape[1] * block_size
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
     mask = key_pos[None, :] <= positions[:, None]  # [B, ctx]
-    kv_lens = jnp.where(active, positions + 1, 0)
+    return tgt_blocks, tgt_offs, mask
+
+
+def decode_layer_scan(
+    layers: Dict[str, jax.Array],
+    c: ModelConfig,
+    k_cache: jax.Array,  # [L', N, BS, KVH, HD] — full stack or a pipeline stage's slice
+    v_cache: jax.Array,
+    h: jax.Array,  # [B, D] embedded inputs (or activations from the previous pp stage)
+    positions: jax.Array,  # [B]
+    tgt_blocks: jax.Array,  # [B] scatter block per row (0 = scratch sink)
+    tgt_offs: jax.Array,  # [B]
+    block_tables: jax.Array,  # [B, max_blocks]
+    mask: jax.Array,  # [B, ctx] bool
+    kv_lens: Optional[jax.Array],  # [B] (kernel path only)
+    use_kernel: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scan the decode layer body over a stacked layer group. Factored out of
+    ``decode`` so pipeline parallelism (pipeline_parallel.py) can run the
+    same body on each stage's local L/pp slice of layers + KV cache."""
+    B = h.shape[0]
+    bs = c.block_size
+    ctx = block_tables.shape[1] * bs
 
     def layer_fn(h, xs):
         lp, kc, vc = xs
@@ -331,7 +334,48 @@ def decode(
         h = h + _mlp(x, lp, c)
         return h, (kc, vc)
 
-    h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
+    h, (k_new, v_new) = lax.scan(layer_fn, h, (layers, k_cache, v_cache))
+    return h, k_new, v_new
+
+
+def decode(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B] current token per sequence
+    positions: jax.Array,  # [B] position of each token (its write slot)
+    block_tables: jax.Array,  # [B, max_blocks]
+    active: jax.Array,  # [B] bool — padded batch slots are False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for a batch. Returns (logits [B, V], k_cache, v_cache)."""
+    c = config
+    bs = c.block_size
+
+    h = params["embed"].at[tokens].get(mode="clip")  # [B, D]
+
+    tgt_blocks, tgt_offs, mask = decode_targets(positions, block_tables, active, bs)
+
+    # "auto" only picks the kernel single-chip (under a GSPMD mesh the
+    # pallas_call would need a shard_map wrapper; the gather path partitions
+    # fine) and only when KV pages are Mosaic-DMA-aligned: lane dim
+    # KVH*HD % 128, sublane BS % 8 (tiny test configs fall back to gather).
+    aligned = (c.kv_size % 128 == 0) and (c.block_size % 8 == 0)
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = c.attention_impl == "paged_kernel" or (
+        c.attention_impl == "auto" and aligned and on_tpu and jax.device_count() == 1
+    )
+    if c.attention_impl == "paged_kernel" and on_tpu and not aligned:
+        raise ValueError(
+            f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
+            f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
+        )
+    kv_lens = jnp.where(active, positions + 1, 0)
+
+    h, k_new, v_new = decode_layer_scan(
+        params["layers"], c, k_cache, v_cache, h, positions,
+        tgt_blocks, tgt_offs, block_tables, mask, kv_lens, use_kernel,
+    )
 
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")

@@ -8,6 +8,8 @@ model.
 
 Axis convention:
 - ``dp``   — data parallel (batch) across chips within one engine instance.
+- ``pp``   — pipeline parallel: stacked layer axis split into stages
+             (microbatched ppermute pipeline; pipeline_parallel.py).
 - ``tp``   — tensor parallel: attention heads + MLP hidden dim.
 - ``ep``   — expert parallel (MoE models).
 - ``sp``   — sequence/context parallel (ring attention, long prefill).
@@ -36,10 +38,11 @@ class ParallelConfig:
     dp: int = 1
     ep: int = 1
     sp: int = 1
+    pp: int = 1
 
     @property
     def total(self) -> int:
-        return self.tp * self.dp * self.ep * self.sp
+        return self.tp * self.dp * self.ep * self.sp * self.pp
 
 
 def build_mesh(parallel: ParallelConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -47,56 +50,63 @@ def build_mesh(parallel: ParallelConfig, devices: Optional[Sequence[jax.Device]]
     n = parallel.total
     if len(devices) < n:
         raise ValueError(f"need {n} devices for {parallel}, have {len(devices)}")
-    arr = np.array(devices[:n]).reshape(parallel.dp, parallel.sp, parallel.ep, parallel.tp)
-    return Mesh(arr, axis_names=("dp", "sp", "ep", "tp"))
+    arr = np.array(devices[:n]).reshape(
+        parallel.dp, parallel.pp, parallel.sp, parallel.ep, parallel.tp
+    )
+    return Mesh(arr, axis_names=("dp", "pp", "sp", "ep", "tp"))
 
 
-def param_specs(tie_word_embeddings: bool, num_experts: int = 0) -> dict:
+def param_specs(tie_word_embeddings: bool, num_experts: int = 0, pp: bool = False) -> dict:
     """PartitionSpec pytree matching llama.init_params structure.
 
     MoE: experts shard over ``ep`` and the FFN hidden dim over ``tp`` —
-    the wide-EP layout (each chip holds E/ep experts, each split tp-ways)."""
+    the wide-EP layout (each chip holds E/ep experts, each split tp-ways).
+    With ``pp=True`` the stacked layer axis additionally shards over ``pp``
+    (each pipeline stage holds L/pp contiguous layers)."""
+    lax_ = "pp" if pp else None  # leading (stacked-layer) axis
     specs = {
         "embed": P("tp", None),
         "final_norm": P(None),
         "layers": {
-            "attn_norm": P(None, None),
-            "mlp_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
+            "attn_norm": P(lax_, None),
+            "mlp_norm": P(lax_, None),
+            "wq": P(lax_, None, "tp"),
+            "wk": P(lax_, None, "tp"),
+            "wv": P(lax_, None, "tp"),
+            "wo": P(lax_, "tp", None),
         },
     }
     if num_experts == 0:
         specs["layers"].update(
-            w_gate=P(None, None, "tp"),
-            w_up=P(None, None, "tp"),
-            w_down=P(None, "tp", None),
+            w_gate=P(lax_, None, "tp"),
+            w_up=P(lax_, None, "tp"),
+            w_down=P(lax_, "tp", None),
         )
     else:
         specs["layers"].update(
-            router=P(None, None, None),
-            w_gate=P(None, "ep", None, "tp"),
-            w_up=P(None, "ep", None, "tp"),
-            w_down=P(None, "ep", "tp", None),
+            router=P(lax_, None, None),
+            w_gate=P(lax_, "ep", None, "tp"),
+            w_up=P(lax_, "ep", None, "tp"),
+            w_down=P(lax_, "ep", "tp", None),
         )
     if not tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
 
 
-def kv_cache_spec(num_kv_heads: int = 0, tp_size: int = 1) -> P:
+def kv_cache_spec(num_kv_heads: int = 0, tp_size: int = 1, pp: bool = False) -> P:
     """[L, N, BS, KVH, HD] — shard kv heads over tp when divisible; when
     tp > kv_heads (e.g. 70B kv_heads=8 on tp=16) the cache replicates and the
-    duplicated-KV-head handling lives in the attention partitioning."""
+    duplicated-KV-head handling lives in the attention partitioning. With
+    ``pp=True`` the layer axis shards over pp alongside the layer stack."""
+    lax_ = "pp" if pp else None
     if tp_size > 1 and num_kv_heads % tp_size == 0:
-        return P(None, None, None, "tp", None)
-    return P(None, None, None, None, None)
+        return P(lax_, None, None, "tp", None)
+    return P(lax_, None, None, None, None)
 
 
-def shard_params(params, mesh: Mesh, tie_word_embeddings: bool, num_experts: int = 0):
-    specs = param_specs(tie_word_embeddings, num_experts)
+def shard_params(params, mesh: Mesh, tie_word_embeddings: bool, num_experts: int = 0, pp: bool = False):
+    specs = param_specs(tie_word_embeddings, num_experts, pp=pp)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,

@@ -24,7 +24,7 @@ CFG = get_config("tiny").replace(dtype="float32")
 
 def test_mesh_axes():
     mesh = build_mesh(ParallelConfig(tp=4, dp=2))
-    assert mesh.shape == {"dp": 2, "sp": 1, "ep": 1, "tp": 4}
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "ep": 1, "tp": 4}
 
 
 def test_param_specs_cover_params():
