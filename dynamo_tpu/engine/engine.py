@@ -137,9 +137,20 @@ class TpuEngine:
     async def _loop(self) -> None:
         try:
             while not self._closed:
+                n = self.scheduler.expire_exports()
+                if n:
+                    logger.warning("reclaimed %d unpulled KV exports past TTL", n)
                 if not (self._staged_adds or self._staged_aborts or self.scheduler.has_work()):
                     self._wake.clear()
-                    await self._wake.wait()
+                    # Wake periodically while exports await pulling so the
+                    # TTL guard runs even when the engine is otherwise idle.
+                    if self.scheduler._pending_exports:
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        await self._wake.wait()
                     continue
                 for rid, tokens, sampling, stop, queue, extras in self._staged_adds:
                     try:

@@ -68,7 +68,6 @@ def pipelined_decode(
     bs = c.block_size
     max_blocks = block_tables.shape[1]
 
-    toks_mb = tokens.reshape(M, mb)
     poss_mb = positions.reshape(M, mb)
     tables_mb = block_tables.reshape(M, mb, max_blocks)
     act_mb = active.reshape(M, mb)
@@ -79,7 +78,12 @@ def pipelined_decode(
     head = embed if tied else params["lm_head"]
     layers = params["layers"]
 
-    def body(layers, kc, vc, embed, toks, poss, tables, act):
+    # Embed all microbatches once, outside the pipeline body: the embedding
+    # table is tp-sharded over the vocab, so the gather (and its collective)
+    # runs once under GSPMD instead of on every stage at every step.
+    h0_mb = embed.at[tokens.reshape(M, mb)].get(mode="clip")  # [M, mb, D]
+
+    def body(layers, kc, vc, h0, poss, tables, act):
         stage = lax.axis_index("pp")
         last = pp - 1
 
@@ -89,15 +93,13 @@ def pipelined_decode(
             in_range = (mb_idx >= 0) & (mb_idx < M)
             i = jnp.clip(mb_idx, 0, M - 1)
 
-            toks_i = jnp.take(toks, i, axis=0)  # [mb]
             poss_i = jnp.take(poss, i, axis=0)
             tables_i = jnp.take(tables, i, axis=0)  # [mb, max_blocks]
             act_i = jnp.take(act, i, axis=0) & in_range
 
-            # Stage 0 embeds its current microbatch; later stages consume the
-            # activation that arrived from the previous stage last step.
-            h0 = embed.at[toks_i].get(mode="clip")
-            h_in = jnp.where(stage == 0, h0, h_prev)
+            # Stage 0 feeds its current microbatch's embeddings; later stages
+            # consume the activation that arrived from the previous stage.
+            h_in = jnp.where(stage == 0, jnp.take(h0, i, axis=0), h_prev)
 
             tgt_blocks, tgt_offs, mask = decode_targets(poss_i, tables_i, act_i, bs)
 
@@ -116,9 +118,9 @@ def pipelined_decode(
             return (h_next, kc, vc, out)
 
         init = (
-            jnp.zeros((mb, c.hidden_size), dtype=embed.dtype),
+            jnp.zeros((mb, c.hidden_size), dtype=h0.dtype),
             kc, vc,
-            jnp.zeros((M, mb, c.hidden_size), dtype=embed.dtype),
+            jnp.zeros((M, mb, c.hidden_size), dtype=h0.dtype),
         )
         _, kc, vc, out = lax.fori_loop(0, M + pp - 1, step, init)
         # out is populated only on the last stage; exactly one stage
@@ -126,19 +128,19 @@ def pipelined_decode(
         # cast routes around an XLA-CPU crash on bf16 all-reduce
         # ("Invalid binary instruction opcode copy") and is harmless on TPU.
         out = lax.psum(jnp.where(stage == last, 1.0, 0.0) * out.astype(jnp.float32), "pp")
-        return out.astype(embed.dtype), kc, vc
+        return out.astype(h0.dtype), kc, vc
 
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P(), P()),
+        in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P()),
         out_specs=(P(), P("pp"), P("pp")),
         axis_names={"pp"},
         check_vma=False,
     )
     out, k_new, v_new = sharded(
-        layers, k_cache, v_cache, embed,
-        toks_mb, poss_mb, tables_mb, act_mb,
+        layers, k_cache, v_cache, h0_mb,
+        poss_mb, tables_mb, act_mb,
     )
     # Final norm + lm head outside the pipeline body: the head weight is
     # tp-sharded, so GSPMD partitions this one matmul over tp.

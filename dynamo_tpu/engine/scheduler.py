@@ -124,6 +124,10 @@ class SchedulerConfig:
     decode_buckets: List[int] = field(default_factory=lambda: [1, 2, 4, 8, 16])
     max_prefill_chunk: int = 2048
     enable_prefix_caching: bool = True
+    # Disagg prefill role: how long finished-prefill KV blocks may await the
+    # decode worker's pull before being reclaimed (orphan guard — e.g. the
+    # decode worker timed out or died between prefill and pull).
+    export_ttl_s: float = 120.0
 
 
 @dataclass
@@ -174,6 +178,7 @@ class Scheduler:
         self.kvbm = None
         # Finished prefill-role sequences awaiting KV export (disagg).
         self._pending_exports: Dict[str, Sequence] = {}
+        self._export_deadline: Dict[str, float] = {}
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
         self.by_id: Dict[str, Sequence] = {}
@@ -448,12 +453,25 @@ class Scheduler:
         from dynamo_tpu.llm.block_manager.transfer import gather_blocks
 
         seq = self._pending_exports.pop(request_id, None)
+        self._export_deadline.pop(request_id, None)
         if seq is None:
             return None
         data = [gather_blocks(self.cache, bid) for bid in seq.block_ids]
         self.allocator.release(seq.block_ids)
         seq.block_ids = []
         return data, seq.block_hashes, len(seq.prompt)
+
+    def expire_exports(self, now: Optional[float] = None) -> int:
+        """Reclaim exports nobody pulled within export_ttl_s. Returns count."""
+        now = time.monotonic() if now is None else now
+        expired = [rid for rid, dl in self._export_deadline.items() if dl < now]
+        for rid in expired:
+            seq = self._pending_exports.pop(rid, None)
+            self._export_deadline.pop(rid, None)
+            if seq is not None:
+                self.allocator.release(seq.block_ids)
+                seq.block_ids = []
+        return len(expired)
 
     # --- helpers ------------------------------------------------------------
     def attach_kvbm(self, kvbm) -> None:
@@ -548,6 +566,7 @@ class Scheduler:
             # Disagg prefill role: hold blocks until the decode worker pulls
             # them (take_export); refs stay live so eviction can't touch them.
             self._pending_exports[seq.request_id] = seq
+            self._export_deadline[seq.request_id] = time.monotonic() + self.sc.export_ttl_s
         else:
             self.allocator.release(seq.block_ids)
             seq.block_ids = []

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import asyncio
 import json
-from dataclasses import dataclass
+import time
+from dataclasses import asdict, dataclass
 from typing import Any, AsyncIterator, List, Optional, Tuple
 
 import msgpack
@@ -32,6 +33,7 @@ from dynamo_tpu.runtime.engine import Annotated, Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.push_router import NoInstancesError, PushRouter, RouterMode
 from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpCallHome
+from dynamo_tpu.runtime.work_queue import WorkQueue
 
 logger = get_logger(__name__)
 
@@ -194,10 +196,93 @@ async def pull_kv_blocks(drt, instance: Instance, request_id: str) -> List[Tuple
 # ---------------------------------------------------------------------------
 
 
+PREFILL_QUEUE = "prefill"
+
+
+async def _first_token_of(stream) -> int:
+    """Consume a prefill response stream; return its first emitted token.
+
+    The prefill role emits exactly one token (max_tokens=1); shared by the
+    push and queue strategies so the output convention lives in one place."""
+    first: Optional[int] = None
+    async for item in stream:
+        data = item.data if isinstance(item, Annotated) else item
+        if first is None and data and data.get("token_ids"):
+            first = data["token_ids"][0]
+    if first is None:
+        raise RuntimeError("prefill returned no token")
+    return first
+
+
+class PrefillQueueWorker:
+    """Prefill-first strategy, worker side (ref: trtllm
+    request_handlers/handler_base.py:42-55 ``DisaggregationStrategy``
+    prefill_first + the NatsQueue prefill-queue path, _core.pyi:894): pull
+    prefill jobs from the shared durable queue, run them on the local
+    engine, and reply to the decode worker's inbox subject. The KV blocks
+    stay registered for pull under the job's request id, exactly as in the
+    push path."""
+
+    def __init__(self, drt, engine, instance: Instance, queue_name: str = PREFILL_QUEUE,
+                 lease_id: Optional[int] = None):
+        self.drt = drt
+        self.engine = engine
+        self.instance = instance
+        self.queue = WorkQueue(drt.store, drt.bus, queue_name, lease_id=lease_id)
+        self.jobs_served = 0
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            item = await self.queue.dequeue(timeout=0.2)
+            if item is None:
+                continue
+            try:
+                await self._serve_job(item.data)
+            except Exception:  # noqa: BLE001 — one bad job must not kill the loop
+                logger.exception("prefill queue job failed")
+            finally:
+                await item.ack()
+
+    async def _serve_job(self, raw: bytes) -> None:
+        job = json.loads(raw)
+        reply_subject = job["reply_subject"]
+        reply = {"request_id": job.get("request_id")}
+        # The decode worker gave up at expires_at (wall clock): running the
+        # job after that would prefill into the void and pin KV blocks until
+        # the export TTL reclaims them — skip instead.
+        expires_at = job.get("expires_at")
+        if expires_at is not None and time.time() > expires_at:
+            logger.warning("dropping expired prefill job %s", reply["request_id"])
+            return
+        try:
+            ctx = Context(id=job["request_id"])
+            first_token = await _first_token_of(self.engine.generate(job["request"], ctx))
+            reply.update(first_token=first_token, instance=asdict(self.instance))
+            self.jobs_served += 1
+        except Exception as e:  # noqa: BLE001 — error crosses the wire
+            reply["error"] = str(e)
+        await self.drt.bus.publish(reply_subject, json.dumps(reply).encode())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+
+
 class DisaggDecodeHandler:
     """The decode worker's endpoint handler (ref: vllm handlers.py:135):
     conditionally forwards prefill to the prefill pool, pulls KV, then runs
-    local decode from the injected cache."""
+    local decode from the injected cache.
+
+    ``strategy`` picks how prefill work reaches the pool (ref: trtllm
+    handler_base.py:42-55): ``decode_first`` pushes directly to a chosen
+    prefill instance; ``prefill_first`` enqueues on the shared durable queue
+    and lets any prefill worker pull it."""
 
     def __init__(
         self,
@@ -205,17 +290,57 @@ class DisaggDecodeHandler:
         engine,
         prefill_client: Optional[Client] = None,
         disagg_router: Optional[DisaggRouter] = None,
+        strategy: str = "decode_first",
+        prefill_queue_name: str = PREFILL_QUEUE,
+        queue_reply_timeout_s: float = 30.0,
     ):
+        if strategy not in ("decode_first", "prefill_first"):
+            raise ValueError(f"unknown disagg strategy: {strategy}")
         self.drt = drt
         self.engine = engine
         self.prefill_client = prefill_client
         self.prefill_router = PushRouter(prefill_client, RouterMode.ROUND_ROBIN) if prefill_client else None
         self.disagg_router = disagg_router
+        self.strategy = strategy
+        self.queue = (
+            WorkQueue(drt.store, drt.bus, prefill_queue_name) if strategy == "prefill_first" else None
+        )
+        self.queue_reply_timeout_s = queue_reply_timeout_s
         self.remote_prefills = 0
         self.local_prefills = 0
 
     def can_prefill_remote(self) -> bool:
+        if self.strategy == "prefill_first":
+            return True  # any live queue worker can pull; absence ⇒ timeout fallback
         return self.prefill_router is not None and bool(self.prefill_client.instances)
+
+    async def _prefill_via_push(self, prefill_req: dict, prefill_ctx: Context) -> Tuple[int, Instance]:
+        instance_id = self.prefill_router.select()
+        instance = self.prefill_client.instances[instance_id]
+        first_token = await _first_token_of(
+            self.prefill_router.generate(prefill_req, prefill_ctx, instance_id=instance_id)
+        )
+        return first_token, instance
+
+    async def _prefill_via_queue(self, prefill_req: dict, prefill_ctx: Context) -> Tuple[int, Instance]:
+        reply_subject = f"prefill_reply.{prefill_ctx.id}"
+        sub = await self.drt.bus.subscribe(reply_subject)
+        try:
+            await self.queue.enqueue(json.dumps({
+                "request": prefill_req,
+                "request_id": prefill_ctx.id,
+                "reply_subject": reply_subject,
+                "expires_at": time.time() + self.queue_reply_timeout_s,
+            }).encode())
+            msg = await sub.next(timeout=self.queue_reply_timeout_s)
+        finally:
+            await sub.unsubscribe()
+        if msg is None:
+            raise RuntimeError("prefill queue reply timed out")
+        reply = json.loads(msg.data)
+        if reply.get("error"):
+            raise RuntimeError(f"queued prefill failed: {reply['error']}")
+        return reply["first_token"], Instance(**reply["instance"])
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         tokens = list(request.get("token_ids") or [])
@@ -235,18 +360,13 @@ class DisaggDecodeHandler:
         prefill_req = dict(request)
         prefill_req["stop_conditions"] = {**(request.get("stop_conditions") or {}), "max_tokens": 1, "ignore_eos": True}
         prefill_req["disagg_params"] = {"do_remote_decode": True}
-        instance_id = self.prefill_router.select()
-        instance = self.prefill_client.instances[instance_id]
         prefill_ctx = context.child()  # same request id crosses the wire
 
-        first_token: Optional[int] = None
         try:
-            async for item in self.prefill_router.generate(prefill_req, prefill_ctx, instance_id=instance_id):
-                data = item.data if isinstance(item, Annotated) else item
-                if data and data.get("token_ids"):
-                    first_token = data["token_ids"][0]
-            if first_token is None:
-                raise RuntimeError("prefill returned no token")
+            if self.strategy == "prefill_first":
+                first_token, instance = await self._prefill_via_queue(prefill_req, prefill_ctx)
+            else:
+                first_token, instance = await self._prefill_via_push(prefill_req, prefill_ctx)
             # 2) Pull the KV blocks (the NIXL-transfer step).
             blocks = await pull_kv_blocks(self.drt, instance, prefill_ctx.id)
         except (NoInstancesError, ConnectionError, RuntimeError) as e:

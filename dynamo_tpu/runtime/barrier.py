@@ -1,0 +1,142 @@
+"""Leader/worker rendezvous barrier over the KV store.
+
+Ref: lib/runtime/src/utils/leader_worker_barrier.rs:1-616 — an etcd-based
+barrier the reference uses for KVBM leader/worker startup and multi-node
+engine coordination. Key layout (under ``barrier/{id}/``):
+
+- ``data``                leader's payload (JSON), create-only
+- ``worker/{worker_id}``  each worker's payload, create-only
+- ``complete``            leader's completion signal
+- ``abort``               leader's abort signal (timeout / failure)
+
+Flow: the leader publishes ``data`` then waits until ``num_workers`` keys
+exist under ``worker/``; it then signals ``complete`` and returns the worker
+payloads. Each worker waits for ``data``, registers itself, then waits for
+``complete`` (returning the leader payload) or ``abort`` (raising). All keys
+bind to the caller's lease when given, so a dead participant's keys vanish
+with its lease instead of wedging the next rendezvous.
+
+TPU-build use: multi-host engine bring-up (mesh coordination over DCN),
+KVBM leader/worker startup, planner fleet rollouts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.runtime.transports.kvstore import EventType, KeyExists, KvStore
+
+BARRIER_ROOT = "barrier"
+
+
+class BarrierAborted(Exception):
+    """Leader signalled abort (or timed out waiting for workers)."""
+
+
+class BarrierTimeout(Exception):
+    pass
+
+
+def _key(barrier_id: str, *suffix: str) -> str:
+    return "/".join((BARRIER_ROOT, barrier_id) + suffix)
+
+
+async def _wait_for_key(store: KvStore, key: str) -> bytes:
+    """Return the key's value as soon as it exists (snapshot or watch)."""
+    watch = await store.watch_prefix(key)
+    try:
+        async for ev in watch:
+            if ev.type == EventType.PUT and ev.key == key and ev.value is not None:
+                return ev.value
+    finally:
+        await watch.cancel()
+    raise BarrierAborted(f"watch closed waiting for {key}")
+
+
+class LeaderBarrier:
+    """Leader side: publish data, wait for N workers, signal completion.
+
+    Ref: leader_worker_barrier.rs:125 (``LeaderBarrier::sync``)."""
+
+    def __init__(self, barrier_id: str, num_workers: int, timeout_s: Optional[float] = None):
+        self.barrier_id = barrier_id
+        self.num_workers = num_workers
+        self.timeout_s = timeout_s
+
+    async def sync(
+        self, store: KvStore, data: Any, lease_id: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Returns {worker_id: worker_data} once all workers checked in."""
+        payload = json.dumps(data).encode()
+        await store.put(_key(self.barrier_id, "data"), payload, lease_id=lease_id, create_only=True)
+        try:
+            workers = await asyncio.wait_for(self._wait_for_workers(store), self.timeout_s)
+        except asyncio.TimeoutError:
+            await store.put(_key(self.barrier_id, "abort"), b"{}", lease_id=lease_id)
+            raise BarrierTimeout(
+                f"barrier {self.barrier_id}: timed out waiting for {self.num_workers} workers"
+            )
+        await store.put(_key(self.barrier_id, "complete"), b"{}", lease_id=lease_id)
+        return workers
+
+    async def _wait_for_workers(self, store: KvStore) -> Dict[str, Any]:
+        prefix = _key(self.barrier_id, "worker") + "/"
+        found: Dict[str, Any] = {}
+        snapshot, watch = await store.get_and_watch_prefix(prefix)
+        try:
+            for e in snapshot:
+                found[e.key[len(prefix):]] = json.loads(e.value)
+            if len(found) >= self.num_workers:
+                return found
+            async for ev in watch:
+                if ev.type == EventType.PUT and ev.value is not None:
+                    found[ev.key[len(prefix):]] = json.loads(ev.value)
+                    if len(found) >= self.num_workers:
+                        return found
+        finally:
+            await watch.cancel()
+        raise BarrierAborted(f"watch closed waiting for workers of {self.barrier_id}")
+
+
+class WorkerBarrier:
+    """Worker side: wait for leader data, register, wait for completion.
+
+    Ref: leader_worker_barrier.rs:218 (``WorkerBarrier::sync``)."""
+
+    def __init__(self, barrier_id: str, worker_id: str, timeout_s: Optional[float] = None):
+        self.barrier_id = barrier_id
+        self.worker_id = worker_id
+        self.timeout_s = timeout_s
+
+    async def sync(self, store: KvStore, data: Any, lease_id: Optional[int] = None) -> Any:
+        """Returns the leader's data after the leader signals completion."""
+        try:
+            return await asyncio.wait_for(self._sync(store, data, lease_id), self.timeout_s)
+        except asyncio.TimeoutError:
+            raise BarrierTimeout(f"barrier {self.barrier_id}: worker {self.worker_id} timed out")
+
+    async def _sync(self, store: KvStore, data: Any, lease_id: Optional[int]) -> Any:
+        leader_raw = await _wait_for_key(store, _key(self.barrier_id, "data"))
+        try:
+            await store.put(
+                _key(self.barrier_id, "worker", self.worker_id),
+                json.dumps(data).encode(),
+                lease_id=lease_id,
+                create_only=True,
+            )
+        except KeyExists:
+            raise KeyExists(
+                f"barrier {self.barrier_id}: duplicate worker id {self.worker_id!r}"
+            )
+        # Wait for whichever signal lands first.
+        complete = asyncio.create_task(_wait_for_key(store, _key(self.barrier_id, "complete")))
+        abort = asyncio.create_task(_wait_for_key(store, _key(self.barrier_id, "abort")))
+        done, pending = await asyncio.wait({complete, abort}, return_when=asyncio.FIRST_COMPLETED)
+        for t in pending:
+            t.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        if abort in done and not abort.cancelled() and abort.exception() is None:
+            raise BarrierAborted(f"barrier {self.barrier_id}: leader aborted")
+        return json.loads(leader_raw)

@@ -1,0 +1,83 @@
+"""Leader/worker barrier rendezvous (ref: utils/leader_worker_barrier.rs)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import (
+    BarrierAborted,
+    BarrierTimeout,
+    LeaderBarrier,
+    WorkerBarrier,
+)
+from dynamo_tpu.runtime.transports.kvstore import KeyExists, MemKvStore
+
+
+async def test_leader_and_workers_rendezvous():
+    store = MemKvStore()
+    leader = LeaderBarrier("b1", num_workers=3)
+    workers = [WorkerBarrier("b1", f"w{i}") for i in range(3)]
+
+    async def run_worker(w, i):
+        return await w.sync(store, {"rank": i})
+
+    results = await asyncio.gather(
+        leader.sync(store, {"mesh": [2, 4]}),
+        *(run_worker(w, i) for i, w in enumerate(workers)),
+    )
+    leader_result, *worker_results = results
+    assert set(leader_result) == {"w0", "w1", "w2"}
+    assert leader_result["w1"] == {"rank": 1}
+    assert all(r == {"mesh": [2, 4]} for r in worker_results)
+    await store.close()
+
+
+async def test_workers_arrive_before_leader():
+    store = MemKvStore()
+    worker_task = asyncio.create_task(WorkerBarrier("b2", "w0").sync(store, {"rank": 0}))
+    await asyncio.sleep(0.05)  # worker is parked waiting for data
+    assert not worker_task.done()
+    leader_result = await LeaderBarrier("b2", num_workers=1).sync(store, "cfg")
+    assert leader_result == {"w0": {"rank": 0}}
+    assert await worker_task == "cfg"
+    await store.close()
+
+
+async def test_leader_timeout_aborts_workers():
+    store = MemKvStore()
+    worker_task = asyncio.create_task(WorkerBarrier("b3", "w0").sync(store, None))
+    with pytest.raises(BarrierTimeout):
+        await LeaderBarrier("b3", num_workers=2, timeout_s=0.2).sync(store, None)
+    with pytest.raises(BarrierAborted):
+        await worker_task
+    await store.close()
+
+
+async def test_duplicate_worker_id_rejected():
+    store = MemKvStore()
+    leader_task = asyncio.create_task(LeaderBarrier("b4", num_workers=2).sync(store, None))
+    ok = asyncio.create_task(WorkerBarrier("b4", "w0").sync(store, None))
+    await asyncio.sleep(0.05)
+    with pytest.raises(KeyExists):
+        await WorkerBarrier("b4", "w0").sync(store, None)
+    # A distinct worker completes the rendezvous.
+    other = asyncio.create_task(WorkerBarrier("b4", "w1").sync(store, None))
+    assert set(await leader_task) == {"w0", "w1"}
+    await asyncio.gather(ok, other)
+    await store.close()
+
+
+async def test_lease_bound_keys_vanish_with_lease():
+    store = MemKvStore(reaper_interval_s=0.05)
+    lease = await store.grant_lease(0.15)
+    leader_task = asyncio.create_task(
+        LeaderBarrier("b5", num_workers=1).sync(store, "d", lease_id=lease.id)
+    )
+    w = await WorkerBarrier("b5", "w0").sync(store, None)
+    assert w == "d"
+    await leader_task
+    assert await store.get("barrier/b5/data") is not None
+    await asyncio.sleep(0.4)  # lease expires; reaper deletes barrier keys
+    assert await store.get("barrier/b5/data") is None
+    assert await store.get("barrier/b5/complete") is None
+    await store.close()

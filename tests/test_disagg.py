@@ -13,6 +13,7 @@ from dynamo_tpu.llm.disagg import (
     DisaggRouter,
     DisaggRouterConf,
     KvExportService,
+    PrefillQueueWorker,
 )
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context
@@ -140,6 +141,91 @@ async def test_prefill_pool_death_falls_back_to_local():
         await decode_engine.stop()
     finally:
         await drt.shutdown()
+
+
+async def test_prefill_first_queue_matches_aggregated():
+    """prefill_first strategy: decode enqueues, a queue worker pulls the job,
+    KV still transfers over the wire — output must match aggregated."""
+    drt = await DistributedRuntime.detached()
+    try:
+        prefill_engine = build_engine()
+        decode_engine = build_engine()
+
+        # Prefill worker registers an endpoint only to own an Instance for the
+        # KV export subject; jobs arrive via the queue, not the push path.
+        prefill_ep = drt.namespace("disagg").component("prefill").endpoint("generate")
+        handle = await prefill_ep.serve_endpoint(prefill_engine.generate, stats_handler=prefill_engine.stats_handler)
+        kvx = KvExportService(drt, prefill_engine, handle.instance)
+        await kvx.start()
+        drt.local_engines.pop(handle.instance.instance_id)
+
+        worker = PrefillQueueWorker(drt, prefill_engine, handle.instance)
+        await worker.start()
+
+        handler = DisaggDecodeHandler(
+            drt, decode_engine, strategy="prefill_first", queue_reply_timeout_s=10.0
+        )
+        prompt = list(range(20, 60))
+
+        ref_engine = build_engine()
+        ref, _ = await collect(ref_engine, req(prompt))
+        await ref_engine.stop()
+
+        out, fin = await collect(handler, req(prompt))
+        assert out == ref, f"prefill_first {out} != aggregated {ref}"
+        assert fin == "length"
+        assert handler.remote_prefills == 1 and worker.jobs_served == 1
+        assert prefill_engine.scheduler.allocator.num_active == 0
+
+        await worker.stop()
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_prefill_first_no_workers_falls_back_local():
+    drt = await DistributedRuntime.detached()
+    try:
+        decode_engine = build_engine()
+        handler = DisaggDecodeHandler(
+            drt, decode_engine, strategy="prefill_first", queue_reply_timeout_s=0.3
+        )
+        out, fin = await collect(handler, req(list(range(40))))
+        assert len(out) == 6 and fin == "length"
+        assert handler.remote_prefills == 1  # attempted, then degraded
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_unpulled_export_reclaimed_after_ttl():
+    """Orphan guard: prefill exports nobody pulls are reclaimed after
+    export_ttl_s instead of pinning KV blocks forever."""
+    engine = TpuEngine.build(
+        EngineArgs(
+            model="tiny", dtype="float32", seed=7,
+            scheduler=SchedulerConfig(num_blocks=64, export_ttl_s=0.3,
+                                      prefill_buckets=[16, 32], decode_buckets=[1, 2]),
+        )
+    )
+    engine.start()
+    try:
+        r = req(list(range(16)), max_tokens=1)
+        r["disagg_params"] = {"do_remote_decode": True}
+        await collect(engine, r)
+        assert engine.scheduler._pending_exports  # export parked, blocks held
+        held = engine.scheduler.allocator.num_active
+        assert held > 0
+        for _ in range(100):  # TTL sweep runs in the idle engine loop
+            if not engine.scheduler._pending_exports:
+                break
+            await asyncio.sleep(0.05)
+        assert not engine.scheduler._pending_exports
+        assert engine.scheduler.allocator.num_active == 0
+    finally:
+        await engine.stop()
 
 
 async def test_disagg_conf_hot_reload():

@@ -1,0 +1,92 @@
+"""Durable work queue (NatsQueue role) + prefill-first disaggregation.
+
+Ref: _core.pyi:894 NatsQueue; trtllm handler_base.py:42-55
+DisaggregationStrategy::prefill_first.
+"""
+
+import asyncio
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.work_queue import WorkQueue
+
+
+async def test_enqueue_dequeue_ack():
+    drt = await DistributedRuntime.detached()
+    try:
+        q = WorkQueue(drt.store, drt.bus, "jobs")
+        await q.enqueue(b"a")
+        await q.enqueue(b"b")
+        assert await q.depth() == 2
+        item = await q.dequeue(timeout=1)
+        assert item.data == b"a"
+        assert await q.depth() == 1  # claimed item no longer available
+        await item.ack()
+        item2 = await q.dequeue(timeout=1)
+        assert item2.data == b"b"
+        await item2.ack()
+        assert await q.depth() == 0
+        assert await q.dequeue(timeout=0.1) is None
+    finally:
+        await drt.shutdown()
+
+
+async def test_competing_consumers_each_item_once():
+    drt = await DistributedRuntime.detached()
+    try:
+        producer = WorkQueue(drt.store, drt.bus, "jobs")
+        for i in range(20):
+            await producer.enqueue(str(i).encode())
+
+        seen = []
+
+        async def consume(name):
+            q = WorkQueue(drt.store, drt.bus, "jobs")
+            while True:
+                item = await q.dequeue(timeout=0.3)
+                if item is None:
+                    return
+                seen.append((name, item.data))
+                await item.ack()
+
+        await asyncio.gather(consume("c1"), consume("c2"), consume("c3"))
+        payloads = sorted(int(d) for _, d in seen)
+        assert payloads == list(range(20))  # exactly-once across consumers
+    finally:
+        await drt.shutdown()
+
+
+async def test_dead_consumer_claim_redelivered():
+    drt = await DistributedRuntime.detached()
+    try:
+        drt.store._reaper_interval_s = 0.05
+        q = WorkQueue(drt.store, drt.bus, "jobs")
+        await q.enqueue(b"task")
+
+        lease = await drt.store.grant_lease(0.15)
+        dead = WorkQueue(drt.store, drt.bus, "jobs", lease_id=lease.id)
+        item = await dead.dequeue(timeout=1)
+        assert item is not None and item.data == b"task"
+        # Consumer dies without ack: its lease lapses, claim evaporates.
+        other = WorkQueue(drt.store, drt.bus, "jobs")
+        redelivered = await other.dequeue(timeout=2)
+        assert redelivered is not None and redelivered.data == b"task"
+        await redelivered.ack()
+    finally:
+        await drt.shutdown()
+
+
+async def test_acked_prefix_purged():
+    drt = await DistributedRuntime.detached()
+    try:
+        q = WorkQueue(drt.store, drt.bus, "jobs")
+        for i in range(5):
+            await q.enqueue(str(i).encode())
+        for _ in range(5):
+            item = await q.dequeue(timeout=1)
+            await item.ack()
+        stream = await drt.bus.stream("wq_jobs")
+        assert stream.first_seq == 6  # fully-acked prefix dropped
+        assert await drt.store.get_prefix("wq/jobs/done/") == []
+    finally:
+        await drt.shutdown()
