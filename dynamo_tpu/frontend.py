@@ -21,6 +21,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     p.add_argument("--http-host", default="0.0.0.0")
     p.add_argument("--http-port", type=int, default=8000)
+    p.add_argument("--grpc-port", type=int, default=None, help="also serve the KServe v2 gRPC frontend")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--router-mode", choices=["round-robin", "random", "kv"], default="round-robin")
     p.add_argument("--busy-threshold", type=float, default=None, help="kv-usage above which a worker is skipped")
@@ -37,6 +38,7 @@ async def amain(args) -> None:
     config = FrontendConfig(
         host=args.http_host,
         port=args.http_port,
+        grpc_port=args.grpc_port,
         router_mode=args.router_mode,
         busy_threshold=args.busy_threshold,
         migration_limit=args.migration_limit,

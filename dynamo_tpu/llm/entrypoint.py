@@ -108,6 +108,7 @@ class FrontendConfig:
 
     host: str = "0.0.0.0"
     port: int = 8000
+    grpc_port: Optional[int] = None  # serve the KServe gRPC frontend too
     router_mode: str = "round-robin"  # round-robin | random | kv
     busy_threshold: Optional[float] = None
     migration_limit: int = 0
@@ -150,6 +151,14 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
     service = HttpService(manager, host=config.host, port=config.port)
     service.watcher = watcher  # keep alive / stoppable
     await service.start()
+    if config.grpc_port is not None:
+        # KServe gRPC twin over the same manager (ref: Input::Grpc,
+        # entrypoint/input.rs:32 + grpc/service/kserve.rs).
+        from dynamo_tpu.llm.grpc import KserveGrpcService
+
+        grpc_service = KserveGrpcService(manager, host=config.host, port=config.grpc_port)
+        await grpc_service.start()
+        service.grpc_service = grpc_service
     return service
 
 

@@ -1,0 +1,3 @@
+"""KServe v2 gRPC frontend (ref: lib/llm/src/grpc/service/kserve.rs)."""
+
+from dynamo_tpu.llm.grpc.service import KserveGrpcService  # noqa: F401

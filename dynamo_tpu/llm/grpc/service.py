@@ -1,0 +1,269 @@
+"""KServe v2 ``GRPCInferenceService`` frontend.
+
+Ref: lib/llm/src/grpc/service/kserve.rs:31+ (tonic service over
+inference.proto) — same tensor conventions:
+
+- input ``text_input`` (BYTES) — the prompt;
+- input ``streaming`` (BOOL) — only valid on ModelStreamInfer;
+- request parameters map → sampling options (``max_tokens``,
+  ``temperature``, ``top_p``, ...);
+- output ``text_output`` (BYTES) — generated text (one chunk per stream
+  response on ModelStreamInfer; the full completion on ModelInfer).
+
+The service dispatches into the same ``ModelManager`` pipelines as the HTTP
+frontend (completions shape), so routing/preprocessing/detokenization are
+shared. Implemented with ``grpc.aio`` generic handlers over protoc-generated
+messages (no grpcio-tools dependency).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional
+
+import grpc
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.grpc import kserve_pb2 as pb
+from dynamo_tpu.llm.http.service import _as_output
+from dynamo_tpu.llm.protocols import openai as oai
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param_value(p: "pb.InferParameter"):
+    kind = p.WhichOneof("parameter_choice")
+    return getattr(p, kind) if kind else None
+
+
+def _get_text_input(req: "pb.ModelInferRequest") -> Optional[str]:
+    for i, t in enumerate(req.inputs):
+        if t.name != "text_input":
+            continue
+        if t.contents.bytes_contents:
+            return t.contents.bytes_contents[0].decode("utf-8", "replace")
+        if i < len(req.raw_input_contents):
+            raw = req.raw_input_contents[i]
+            # BYTES raw wire format: u32-le length prefix + payload.
+            if len(raw) >= 4:
+                n = int.from_bytes(raw[:4], "little")
+                if 4 + n <= len(raw):
+                    return raw[4 : 4 + n].decode("utf-8", "replace")
+            return raw.decode("utf-8", "replace")
+    return None
+
+
+def _get_bool_input(req: "pb.ModelInferRequest", name: str) -> bool:
+    for i, t in enumerate(req.inputs):
+        if t.name != name:
+            continue
+        if t.contents.bool_contents:
+            return bool(t.contents.bool_contents[0])
+        if i < len(req.raw_input_contents) and req.raw_input_contents[i]:
+            # BOOL raw wire format: one byte per element.
+            return bool(req.raw_input_contents[i][0])
+    return False
+
+
+class BadRequest(ValueError):
+    """Client-side protocol error → INVALID_ARGUMENT / in-stream error."""
+
+
+def _to_body(req: "pb.ModelInferRequest", stream: bool) -> dict:
+    body = {"model": req.model_name, "prompt": _get_text_input(req) or "", "stream": stream}
+    for key, p in req.parameters.items():
+        val = _param_value(p)
+        try:
+            if key in ("max_tokens", "min_tokens", "top_k", "seed", "n"):
+                body[key] = int(val)
+            elif key in ("temperature", "top_p", "frequency_penalty", "presence_penalty"):
+                body[key] = float(val)
+            elif key in ("stop",):
+                body[key] = str(val)
+            elif key == "ignore_eos":
+                body[key] = bool(val)
+        except (TypeError, ValueError):
+            raise BadRequest(f"bad value for parameter {key!r}: {val!r}")
+    return body
+
+
+def _infer_response(req_id: str, model: str, text: str, finish_reason: Optional[str] = None) -> "pb.ModelInferResponse":
+    resp = pb.ModelInferResponse(model_name=model, id=req_id)
+    out = resp.outputs.add()
+    out.name = "text_output"
+    out.datatype = "BYTES"
+    out.shape.extend([1])
+    out.contents.bytes_contents.append(text.encode())
+    if finish_reason:
+        resp.parameters["finish_reason"].string_param = finish_reason
+    return resp
+
+
+class KserveGrpcService:
+    """gRPC twin of ``HttpService``: same manager, same pipelines."""
+
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0", port: int = 0):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.server: Optional[grpc.aio.Server] = None
+
+    # --- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        self.server = grpc.aio.server()
+        u = grpc.unary_unary_rpc_method_handler
+        handlers = {
+            "ServerLive": u(
+                self.server_live,
+                request_deserializer=pb.ServerLiveRequest.FromString,
+                response_serializer=pb.ServerLiveResponse.SerializeToString,
+            ),
+            "ServerReady": u(
+                self.server_ready,
+                request_deserializer=pb.ServerReadyRequest.FromString,
+                response_serializer=pb.ServerReadyResponse.SerializeToString,
+            ),
+            "ModelReady": u(
+                self.model_ready,
+                request_deserializer=pb.ModelReadyRequest.FromString,
+                response_serializer=pb.ModelReadyResponse.SerializeToString,
+            ),
+            "ServerMetadata": u(
+                self.server_metadata,
+                request_deserializer=pb.ServerMetadataRequest.FromString,
+                response_serializer=pb.ServerMetadataResponse.SerializeToString,
+            ),
+            "ModelMetadata": u(
+                self.model_metadata,
+                request_deserializer=pb.ModelMetadataRequest.FromString,
+                response_serializer=pb.ModelMetadataResponse.SerializeToString,
+            ),
+            "ModelInfer": u(
+                self.model_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelInferResponse.SerializeToString,
+            ),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self.model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString,
+            ),
+        }
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        bound = self.server.add_insecure_port(f"{self.host}:{self.port}")
+        if bound == 0:
+            raise RuntimeError(f"could not bind grpc frontend to {self.host}:{self.port}")
+        self.port = bound
+        await self.server.start()
+        logger.info("kserve grpc frontend on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            await self.server.stop(grace=1.0)
+            self.server = None
+
+    # --- health/metadata ----------------------------------------------------
+    async def server_live(self, request, context) -> "pb.ServerLiveResponse":
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context) -> "pb.ServerReadyResponse":
+        return pb.ServerReadyResponse(ready=True)
+
+    async def model_ready(self, request, context) -> "pb.ModelReadyResponse":
+        return pb.ModelReadyResponse(ready=self.manager.has_model(request.name))
+
+    async def server_metadata(self, request, context) -> "pb.ServerMetadataResponse":
+        return pb.ServerMetadataResponse(name="dynamo-tpu", version="0", extensions=[])
+
+    async def model_metadata(self, request, context) -> "pb.ModelMetadataResponse":
+        if not self.manager.has_model(request.name):
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.name!r} not found")
+        resp = pb.ModelMetadataResponse(name=request.name, versions=["1"], platform="dynamo")
+        for name, dt in (("text_input", "BYTES"), ("streaming", "BOOL")):
+            t = resp.inputs.add()
+            t.name, t.datatype = name, dt
+            t.shape.extend([1])
+        out = resp.outputs.add()
+        out.name, out.datatype = "text_output", "BYTES"
+        out.shape.extend([-1])
+        return resp
+
+    # --- inference ----------------------------------------------------------
+    def _engine_for(self, model: str):
+        return self.manager.get("completions", model) or self.manager.get("chat", model)
+
+    async def model_infer(self, request: "pb.ModelInferRequest", context) -> "pb.ModelInferResponse":
+        if _get_bool_input(request, "streaming"):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "streaming is only supported via ModelStreamInfer",
+            )
+        engine = self._engine_for(request.model_name)
+        if engine is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.model_name!r} not found")
+        if _get_text_input(request) is None:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "missing text_input tensor")
+        try:
+            body = _to_body(request, stream=False)
+        except BadRequest as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        ctx = Context()
+        parts, finish = [], None
+        try:
+            async for item in engine.generate(body, ctx):
+                out = _as_output(item)
+                if out is None:
+                    continue
+                if out.text:
+                    parts.append(out.text)
+                finish = out.finish_reason or finish
+        except asyncio.CancelledError:
+            # Client cancelled the RPC: stop the worker-side generation too.
+            ctx.stop_generating()
+            raise
+        except Exception as e:  # noqa: BLE001 — becomes a gRPC status
+            logger.exception("grpc infer %s failed", ctx.id)
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return _infer_response(request.id or oai.make_id("infer"), request.model_name, "".join(parts), finish)
+
+    async def model_stream_infer(
+        self, request_iterator, context
+    ) -> AsyncIterator["pb.ModelStreamInferResponse"]:
+        async for request in request_iterator:
+            engine = self._engine_for(request.model_name)
+            if engine is None:
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"model {request.model_name!r} not found"
+                )
+                continue
+            if _get_text_input(request) is None:
+                yield pb.ModelStreamInferResponse(error_message="missing text_input tensor")
+                continue
+            try:
+                body = _to_body(request, stream=True)
+            except BadRequest as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+                continue
+            rid = request.id or oai.make_id("infer")
+            ctx = Context()
+            try:
+                async for item in engine.generate(body, ctx):
+                    out = _as_output(item)
+                    if out is None:
+                        continue
+                    if out.text or out.finish_reason:
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=_infer_response(rid, request.model_name, out.text or "", out.finish_reason)
+                        )
+            except asyncio.CancelledError:
+                ctx.stop_generating()
+                raise
+            except Exception as e:  # noqa: BLE001 — becomes an in-stream error
+                logger.exception("grpc stream infer %s failed", ctx.id)
+                yield pb.ModelStreamInferResponse(error_message=str(e))

@@ -51,6 +51,9 @@ class HttpService:
         self.port = port
         self.metrics = metrics or MetricsRegistry(prefix=FRONTEND_PREFIX)
         self._runner: Optional[web.AppRunner] = None
+        # Optional KServe gRPC twin sharing this manager; attached by the
+        # entrypoint (start_frontend) and stopped with this service.
+        self.grpc_service = None
 
         m = self.metrics
         self._m_requests = lambda model, status: m.counter(
@@ -91,9 +94,14 @@ class HttpService:
         logger.info("OpenAI HTTP frontend on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        try:
+            if self.grpc_service is not None:
+                await self.grpc_service.stop()
+                self.grpc_service = None
+        finally:
+            if self._runner is not None:
+                await self._runner.cleanup()
+                self._runner = None
 
     # --- routes -------------------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:

@@ -1,0 +1,146 @@
+"""KServe v2 gRPC frontend e2e (ref: grpc/service/kserve.rs + tests/serve).
+
+Drives the real grpc.aio server over localhost with generated protobuf
+messages: health/metadata, unary ModelInfer, and ModelStreamInfer chunks.
+"""
+
+import grpc
+import pytest
+
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.entrypoint import build_local_pipeline
+from dynamo_tpu.llm.grpc import KserveGrpcService
+from dynamo_tpu.llm.grpc import kserve_pb2 as pb
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+MODEL = "tiny-grpc"
+SVC = "/inference.GRPCInferenceService/"
+
+
+def tiny_engine() -> TpuEngine:
+    return TpuEngine.build(
+        EngineArgs(
+            model="tiny",
+            dtype="float32",
+            eos_token_ids=[0],
+            scheduler=SchedulerConfig(num_blocks=64, prefill_buckets=[16, 32, 64, 128], decode_buckets=[1, 2, 4, 8]),
+        )
+    )
+
+
+async def start_service():
+    engine = tiny_engine()
+    manager = ModelManager()
+    manager.add_model("completions", MODEL, build_local_pipeline(ByteTokenizer(), engine))
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    await svc.start()
+    return svc, engine
+
+
+def infer_request(prompt: str, max_tokens: int = 8, streaming: bool = False) -> pb.ModelInferRequest:
+    req = pb.ModelInferRequest(model_name=MODEL, id="req-1")
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.extend([1])
+    t.contents.bytes_contents.append(prompt.encode())
+    if streaming:
+        s = req.inputs.add()
+        s.name, s.datatype = "streaming", "BOOL"
+        s.shape.extend([1])
+        s.contents.bool_contents.append(True)
+    req.parameters["max_tokens"].int64_param = max_tokens
+    req.parameters["temperature"].double_param = 0.0
+    return req
+
+
+async def test_health_and_metadata():
+    svc, engine = await start_service()
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{svc.port}") as ch:
+            live = await ch.unary_unary(
+                SVC + "ServerLive",
+                request_serializer=pb.ServerLiveRequest.SerializeToString,
+                response_deserializer=pb.ServerLiveResponse.FromString,
+            )(pb.ServerLiveRequest())
+            assert live.live
+            ready = await ch.unary_unary(
+                SVC + "ModelReady",
+                request_serializer=pb.ModelReadyRequest.SerializeToString,
+                response_deserializer=pb.ModelReadyResponse.FromString,
+            )(pb.ModelReadyRequest(name=MODEL))
+            assert ready.ready
+            meta = await ch.unary_unary(
+                SVC + "ModelMetadata",
+                request_serializer=pb.ModelMetadataRequest.SerializeToString,
+                response_deserializer=pb.ModelMetadataResponse.FromString,
+            )(pb.ModelMetadataRequest(name=MODEL))
+            assert [t.name for t in meta.inputs] == ["text_input", "streaming"]
+            assert meta.outputs[0].name == "text_output"
+            missing = await ch.unary_unary(
+                SVC + "ModelReady",
+                request_serializer=pb.ModelReadyRequest.SerializeToString,
+                response_deserializer=pb.ModelReadyResponse.FromString,
+            )(pb.ModelReadyRequest(name="nope"))
+            assert not missing.ready
+    finally:
+        await svc.stop()
+        await engine.stop()
+
+
+async def test_model_infer_unary():
+    svc, engine = await start_service()
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{svc.port}") as ch:
+            infer = ch.unary_unary(
+                SVC + "ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            resp = await infer(infer_request("hello tpu"))
+            assert resp.model_name == MODEL and resp.id == "req-1"
+            assert resp.outputs[0].name == "text_output"
+            text = resp.outputs[0].contents.bytes_contents[0].decode()
+            assert isinstance(text, str)  # byte tokenizer output, any content
+            assert resp.parameters["finish_reason"].string_param in ("length", "stop")
+
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await infer(infer_request("x", streaming=True))
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+            bad = infer_request("x")
+            bad.model_name = "nope"
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await infer(bad)
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await svc.stop()
+        await engine.stop()
+
+
+async def test_model_stream_infer():
+    svc, engine = await start_service()
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{svc.port}") as ch:
+            stream = ch.stream_stream(
+                SVC + "ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            call = stream()
+            await call.write(infer_request("stream me", max_tokens=6, streaming=True))
+            await call.done_writing()
+            chunks = []
+            finish = None
+            async for resp in call:
+                assert not resp.error_message
+                out = resp.infer_response.outputs[0]
+                chunks.append(out.contents.bytes_contents[0].decode())
+                fr = resp.infer_response.parameters["finish_reason"].string_param
+                finish = fr or finish
+            assert len(chunks) >= 1
+            assert finish in ("length", "stop")
+    finally:
+        await svc.stop()
+        await engine.stop()

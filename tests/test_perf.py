@@ -1,0 +1,83 @@
+"""Stream perf capture + JSONL recorder (ref: perf.rs, recorder.rs)."""
+
+import asyncio
+import json
+
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.engine.kv_cache import KvEvent
+from dynamo_tpu.llm.perf import (
+    KvRecorder,
+    RecordedStream,
+    Recorder,
+    analyze_logprobs,
+    record_stream,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def test_record_stream_passthrough_and_stats():
+    async def gen():
+        for i in range(5):
+            await asyncio.sleep(0.01)
+            yield {"token_ids": [i]}
+
+    rec = RecordedStream()
+    items = [item async for item in record_stream(gen(), rec)]
+    assert [i["token_ids"][0] for i in items] == list(range(5))  # unchanged
+    assert len(rec.responses) == 5
+    assert rec.ttft_s > 0
+    assert len(rec.itls_s) == 4 and all(d > 0 for d in rec.itls_s)
+    s = rec.summarize()
+    assert s["responses"] == 5 and s["itl_p50_s"] > 0 and s["duration_s"] >= s["ttft_s"]
+
+
+async def test_recorder_writes_jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = Recorder(path)
+    rec.start()
+    for i in range(10):
+        rec.emit("step", i=i)
+    await rec.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 10 and rec.events_written == 10
+    assert lines[3]["event"] == "step" and lines[3]["i"] == 3
+    assert all("ts" in l for l in lines)
+
+
+async def test_kv_recorder_taps_event_stream(tmp_path):
+    drt = await DistributedRuntime.detached()
+    try:
+        path = str(tmp_path / "kv.jsonl")
+        rec = Recorder(path)
+        rec.start()
+        tap = KvRecorder(drt, "ns", "backend", rec)
+        await tap.start()
+
+        pub = KvEventPublisher(drt, "ns", "backend", worker_id=7)
+        pub.start()
+        pub.publish(KvEvent(kind="stored", block_hashes=[1, 2, 3], parent_hash=None))
+        pub.publish(KvEvent(kind="removed", block_hashes=[2]))
+
+        for _ in range(100):
+            if rec.events_written >= 2:
+                break
+            await asyncio.sleep(0.02)
+        await pub.stop()
+        await tap.stop()
+        await rec.close()
+
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) >= 2
+        assert lines[0]["event"] == "kv_event" and lines[0]["worker_id"] == 7
+        kinds = [l.get("kind") or l.get("type") for l in lines]
+        assert "stored" in str(kinds)
+    finally:
+        await drt.shutdown()
+
+
+def test_analyze_logprobs():
+    out = analyze_logprobs([-0.1, -0.2, -0.3])
+    assert out["tokens"] == 3
+    assert abs(out["mean_logprob"] + 0.2) < 1e-9
+    assert out["perplexity"] > 1.0
+    assert analyze_logprobs([])["perplexity"] is None
