@@ -1,0 +1,14 @@
+"""Deployment tooling (ref: deploy/cloud — the Go k8s operator + CRDs).
+
+- :mod:`spec`      — ``GraphDeployment``: the DynamoGraphDeployment-CRD
+  equivalent, a declarative multi-service serving graph in YAML.
+- :mod:`manifests` — render a GraphDeployment to Kubernetes manifests
+  (what the reference operator's reconcile loop materializes).
+- :mod:`operator`  — a local process-supervising reconciler: desired
+  replicas → running OS processes, with crash restart and graceful
+  scale-down; the planner scales it through ``GraphConnector``.
+"""
+
+from dynamo_tpu.deploy.manifests import render_manifests  # noqa: F401
+from dynamo_tpu.deploy.operator import GraphConnector, LocalOperator  # noqa: F401
+from dynamo_tpu.deploy.spec import GraphDeployment, ResourceSpec, ServiceSpec  # noqa: F401

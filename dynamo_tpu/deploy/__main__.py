@@ -1,0 +1,68 @@
+"""Deploy CLI: ``python -m dynamo_tpu.deploy {render,run} graph.yaml``.
+
+- ``render`` — print Kubernetes manifests for the graph (pipe to
+  ``kubectl apply -f -``); the reference's operator reconcile output.
+- ``run``    — supervise the graph locally: spawn each service's replicas,
+  restart crashes, SIGTERM drains on exit (single TPU-host deployments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.deploy.manifests import render_yaml
+from dynamo_tpu.deploy.operator import LocalOperator
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.runtime.logging import init_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo_tpu.deploy")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="render k8s manifests")
+    r.add_argument("graph", help="graph deployment YAML path")
+    r.add_argument("--image", default="dynamo-tpu:latest")
+    r.add_argument("--tpu-accelerator", default=None, help="GKE node selector value")
+    r.add_argument("--tpu-topology", default=None)
+    u = sub.add_parser("run", help="supervise the graph locally")
+    u.add_argument("graph", help="graph deployment YAML path")
+    u.add_argument("--interval", type=float, default=1.0, help="reconcile interval seconds")
+    return p
+
+
+async def _run(graph: GraphDeployment, interval: float) -> None:
+    op = LocalOperator(graph)
+    op.start(interval_s=interval)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await op.shutdown()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    graph = GraphDeployment.load(args.graph)
+    if args.cmd == "render":
+        try:
+            print(render_yaml(
+                graph,
+                image=args.image,
+                tpu_accelerator=args.tpu_accelerator,
+                tpu_topology=args.tpu_topology,
+            ))
+        except BrokenPipeError:  # e.g. piped into head
+            pass
+        return
+    init_logging()
+    try:
+        asyncio.run(_run(graph, args.interval))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Render a GraphDeployment to Kubernetes manifests.
+
+Ref: deploy/cloud/operator — the reconcile loop that materializes
+DynamoGraphDeployment CRDs into Deployments/Services; and deploy/helm.
+Here rendering is a pure function so it can be unit-tested and piped to
+``kubectl apply -f -`` without a controller in the cluster.
+
+TPU conventions (GKE): chips are requested via the ``google.com/tpu``
+resource on containers and the node pool is selected with
+``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology`` selectors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import yaml
+
+from dynamo_tpu.deploy.spec import GraphDeployment, ServiceSpec
+
+
+def _labels(graph: GraphDeployment, service: str) -> Dict[str, str]:
+    return {
+        "app.kubernetes.io/name": graph.name,
+        "app.kubernetes.io/component": service,
+        "app.kubernetes.io/managed-by": "dynamo-tpu",
+    }
+
+
+def _container(graph: GraphDeployment, svc: ServiceSpec, image: str) -> dict:
+    env = {**graph.base_env(), **svc.env}
+    limits: Dict[str, str] = {"cpu": svc.resources.cpu, "memory": svc.resources.memory}
+    if svc.resources.tpu_chips > 0:
+        limits["google.com/tpu"] = str(svc.resources.tpu_chips)
+    return {
+        "name": svc.name,
+        "image": image,
+        "command": list(svc.command),
+        "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
+        "resources": {"limits": limits, "requests": dict(limits)},
+        "ports": [{"containerPort": 8000, "name": "http"}],
+    }
+
+
+def _deployment(graph: GraphDeployment, svc: ServiceSpec, image: str,
+                tpu_accelerator: Optional[str], tpu_topology: Optional[str]) -> dict:
+    labels = _labels(graph, svc.name)
+    pod_spec: dict = {"containers": [_container(graph, svc, image)]}
+    if svc.resources.tpu_chips > 0:
+        selector = {}
+        if tpu_accelerator:
+            selector["cloud.google.com/gke-tpu-accelerator"] = tpu_accelerator
+        if tpu_topology:
+            selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+        if selector:
+            pod_spec["nodeSelector"] = selector
+    # Copies, not references: shared dicts would serialize as YAML anchors.
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{graph.name}-{svc.name}",
+            "namespace": graph.namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "replicas": svc.replicas,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {"metadata": {"labels": dict(labels)}, "spec": pod_spec},
+        },
+    }
+
+
+def _service(graph: GraphDeployment, svc: ServiceSpec) -> dict:
+    labels = _labels(graph, svc.name)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{graph.name}-{svc.name}",
+            "namespace": graph.namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "selector": dict(labels),
+            "ports": [{"port": 8000, "targetPort": "http", "name": "http"}],
+        },
+    }
+
+
+def render_manifests(
+    graph: GraphDeployment,
+    *,
+    image: str = "dynamo-tpu:latest",
+    tpu_accelerator: Optional[str] = None,
+    tpu_topology: Optional[str] = None,
+    expose: Optional[List[str]] = None,
+) -> List[dict]:
+    """Graph → [Deployment + (optional) Service per service]. ``expose``
+    lists services that get a k8s Service (default: any named 'frontend')."""
+    expose = expose if expose is not None else [n for n in graph.services if n == "frontend"]
+    out: List[dict] = []
+    for svc in graph.services.values():
+        out.append(_deployment(graph, svc, image, tpu_accelerator, tpu_topology))
+        if svc.name in expose:
+            out.append(_service(graph, svc))
+    return out
+
+
+def render_yaml(graph: GraphDeployment, **kwargs) -> str:
+    """Multi-document YAML ready for ``kubectl apply -f -``."""
+    return "\n---\n".join(yaml.safe_dump(m, sort_keys=False) for m in render_manifests(graph, **kwargs))

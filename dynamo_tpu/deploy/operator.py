@@ -1,0 +1,187 @@
+"""Local graph operator: reconcile desired replicas → running OS processes.
+
+Ref: deploy/cloud/operator (Go) — the controller that reconciles
+DynamoGraphDeployment state; here scoped to one host (a TPU VM), which is
+also how the planner e2e path runs a real scaling loop without a cluster.
+
+Semantics:
+- ``reconcile()`` spawns/terminates child processes until each service's
+  live count matches its spec.
+- Crashed children are detected on the next reconcile tick and respawned
+  (up to ``max_restarts`` per service within the backoff window; then the
+  service is marked degraded — visible in ``status()``).
+- Scale-down terminates newest-first with SIGTERM, escalating to SIGKILL
+  after ``grace_s`` (the graceful-drain window; workers drain in-flight
+  requests on SIGTERM via runtime signal handlers).
+
+The planner drives this through :class:`GraphConnector` (the same
+``Connector`` interface as the kubectl/virtual connectors).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.planner.connectors import Connector
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _Child:
+    proc: asyncio.subprocess.Process
+    started_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.returncode is None
+
+
+class LocalOperator:
+    def __init__(
+        self,
+        graph: GraphDeployment,
+        *,
+        grace_s: float = 10.0,
+        max_restarts: int = 3,
+        restart_window_s: float = 60.0,
+    ):
+        self.graph = graph
+        self.grace_s = grace_s
+        self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
+        self._children: Dict[str, List[_Child]] = {name: [] for name in graph.services}
+        self._restarts: Dict[str, List[float]] = {name: [] for name in graph.services}
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        # Serializes reconcile(): the background tick and planner-driven
+        # GraphConnector calls must not interleave mid-spawn (over-spawning
+        # would double-book TPU chips until the next tick corrects it).
+        self._lock = asyncio.Lock()
+
+    # --- desired state ------------------------------------------------------
+    def set_replicas(self, service: str, replicas: int) -> None:
+        if service not in self.graph.services:
+            raise KeyError(f"unknown service {service!r}")
+        self.graph.services[service].replicas = max(0, int(replicas))
+
+    def status(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "desired": spec.replicas,
+                "live": sum(c.alive for c in self._children[name]),
+                "degraded": self._degraded(name),
+            }
+            for name, spec in self.graph.services.items()
+        }
+
+    def _degraded(self, service: str) -> bool:
+        cutoff = time.monotonic() - self.restart_window_s
+        # Prune outside the window so the list stays O(max_restarts) for
+        # long-lived crash-looping services.
+        self._restarts[service] = [t for t in self._restarts[service] if t > cutoff]
+        return len(self._restarts[service]) >= self.max_restarts
+
+    # --- reconcile ----------------------------------------------------------
+    async def reconcile(self) -> None:
+        async with self._lock:
+            for name, spec in self.graph.services.items():
+                try:
+                    await self._reconcile_service(name, spec)
+                except Exception:
+                    # One service failing to spawn (bad command, resources)
+                    # must not starve the rest; count it toward the crash
+                    # window so a persistent failure degrades instead of
+                    # log-spamming forever.
+                    self._restarts[name].append(time.monotonic())
+                    logger.exception("reconcile of %s/%s failed", self.graph.name, name)
+
+    async def _reconcile_service(self, name: str, spec) -> None:
+        children = self._children[name]
+        # Reap the dead; count them as restarts-needed.
+        dead = [c for c in children if not c.alive]
+        for c in dead:
+            children.remove(c)
+            self._restarts[name].append(time.monotonic())
+            logger.warning("%s/%s exited rc=%s", self.graph.name, name, c.proc.returncode)
+        if self._degraded(name):
+            return  # crash-looping: hold off until the window clears
+        while sum(c.alive for c in children) < spec.replicas:
+            children.append(await self._spawn(name))
+        excess = sum(c.alive for c in children) - spec.replicas
+        if excess > 0:
+            victims = [c for c in children if c.alive][-excess:]
+            await asyncio.gather(*(self._terminate(name, c) for c in victims))
+            for c in victims:
+                if c in children:
+                    children.remove(c)
+
+    async def _spawn(self, service: str) -> _Child:
+        spec = self.graph.services[service]
+        env = {**os.environ, **self.graph.base_env(), **spec.env}
+        proc = await asyncio.create_subprocess_exec(
+            *spec.command,
+            env=env,
+            stdout=sys.stdout if sys.stdout.isatty() else asyncio.subprocess.DEVNULL,
+            stderr=sys.stderr if sys.stderr.isatty() else asyncio.subprocess.DEVNULL,
+        )
+        logger.info("%s/%s spawned pid=%d", self.graph.name, service, proc.pid)
+        return _Child(proc=proc)
+
+    async def _terminate(self, service: str, child: _Child) -> None:
+        if not child.alive:
+            return
+        child.proc.send_signal(signal.SIGTERM)  # graceful drain window
+        try:
+            await asyncio.wait_for(child.proc.wait(), timeout=self.grace_s)
+        except asyncio.TimeoutError:
+            logger.warning("%s/%s pid=%d did not drain; killing", self.graph.name, service, child.proc.pid)
+            child.proc.kill()
+            await child.proc.wait()
+
+    # --- run loop -----------------------------------------------------------
+    def start(self, interval_s: float = 1.0) -> None:
+        async def loop():
+            while not self._stop.is_set():
+                try:
+                    await self.reconcile()
+                except Exception:
+                    logger.exception("reconcile failed")
+                try:
+                    await asyncio.wait_for(self._stop.wait(), timeout=interval_s)
+                except asyncio.TimeoutError:
+                    pass
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+
+    async def shutdown(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        for name, children in self._children.items():
+            await asyncio.gather(*(self._terminate(name, c) for c in children))
+            children.clear()
+
+
+class GraphConnector(Connector):
+    """Planner-facing adapter: SLA/load planner decisions land on the local
+    operator exactly as KubernetesConnector lands them on a DGD."""
+
+    def __init__(self, operator: LocalOperator):
+        self.operator = operator
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        self.operator.set_replicas(component, replicas)
+        await self.operator.reconcile()
+
+    async def get_replicas(self, component: str) -> int:
+        return self.operator.graph.services[component].replicas

@@ -1,0 +1,144 @@
+"""Graph deployment spec — the DynamoGraphDeployment CRD equivalent.
+
+Ref: deploy/cloud/operator/api/v1alpha1 (DynamoGraphDeployment /
+DynamoComponentDeployment CRDs): a named graph of services (frontend,
+decode workers, prefill workers, planner, ...) each with a command,
+replica count, resources, and environment. The same spec drives both the
+local process operator (operator.py) and k8s manifest rendering
+(manifests.py), so a graph tested on one TPU host deploys unchanged to a
+cluster.
+
+Example YAML::
+
+    name: llama-8b-disagg
+    namespace: dynamo
+    control_plane: tcp://cp.dynamo.svc:6650
+    services:
+      frontend:
+        command: [python, -m, dynamo_tpu.frontend, --router-mode, kv]
+        replicas: 1
+      decode:
+        command: [python, -m, dynamo_tpu.worker, --model, llama-3-8b]
+        replicas: 2
+        resources: {tpu_chips: 4, memory: 32Gi}
+      prefill:
+        command: [python, -m, dynamo_tpu.worker, --model, llama-3-8b, --is-prefill-worker]
+        replicas: 1
+        resources: {tpu_chips: 4, memory: 32Gi}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+
+@dataclass
+class ResourceSpec:
+    """Per-replica resource ask (TPU chips map to ``google.com/tpu``)."""
+
+    tpu_chips: int = 0
+    cpu: str = "1"
+    memory: str = "2Gi"
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ResourceSpec":
+        d = d or {}
+        return cls(
+            tpu_chips=int(d.get("tpu_chips", 0)),
+            cpu=str(d.get("cpu", "1")),
+            memory=str(d.get("memory", "2Gi")),
+        )
+
+    def to_dict(self) -> dict:
+        return {"tpu_chips": self.tpu_chips, "cpu": self.cpu, "memory": self.memory}
+
+
+@dataclass
+class ServiceSpec:
+    """One service in the graph (ref: DynamoComponentDeployment)."""
+
+    name: str
+    command: List[str]
+    replicas: int = 1
+    resources: ResourceSpec = field(default_factory=ResourceSpec)
+    env: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, name: str, d: dict) -> "ServiceSpec":
+        if not d.get("command"):
+            raise ValueError(f"service {name!r}: command is required")
+        return cls(
+            name=name,
+            command=[str(c) for c in d["command"]],
+            replicas=int(d.get("replicas", 1)),
+            resources=ResourceSpec.from_dict(d.get("resources")),
+            env={k: str(v) for k, v in (d.get("env") or {}).items()},
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "command": list(self.command),
+            "replicas": self.replicas,
+            "resources": self.resources.to_dict(),
+            "env": dict(self.env),
+        }
+
+
+@dataclass
+class GraphDeployment:
+    """A complete serving graph (ref: DynamoGraphDeployment CRD)."""
+
+    name: str
+    services: Dict[str, ServiceSpec]
+    namespace: str = "dynamo"
+    control_plane: str = ""  # e.g. tcp://host:6650; empty = per-process mem
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GraphDeployment":
+        if not d.get("name"):
+            raise ValueError("graph deployment needs a name")
+        services = {
+            name: ServiceSpec.from_dict(name, sd) for name, sd in (d.get("services") or {}).items()
+        }
+        if not services:
+            raise ValueError(f"graph {d['name']!r} has no services")
+        return cls(
+            name=str(d["name"]),
+            services=services,
+            namespace=str(d.get("namespace", "dynamo")),
+            control_plane=str(d.get("control_plane", "")),
+        )
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "GraphDeployment":
+        return cls.from_dict(yaml.safe_load(text))
+
+    @classmethod
+    def load(cls, path: str) -> "GraphDeployment":
+        with open(path) as f:
+            return cls.from_yaml(f.read())
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "control_plane": self.control_plane,
+            "services": {n: s.to_dict() for n, s in self.services.items()},
+        }
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    def base_env(self) -> Dict[str, str]:
+        """Environment every service gets: namespace + control plane."""
+        env = {"DYN_NAMESPACE": self.namespace}
+        if self.control_plane:
+            scheme, sep, address = self.control_plane.partition("://")
+            if not sep:  # schemeless "host:port" → default tcp backend
+                scheme, address = "tcp", self.control_plane
+            env["DYN_CONTROL_PLANE"] = scheme
+            env["DYN_CONTROL_PLANE_ADDRESS"] = address
+        return env

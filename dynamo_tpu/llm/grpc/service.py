@@ -25,7 +25,7 @@ import grpc
 
 from dynamo_tpu.llm.discovery import ModelManager
 from dynamo_tpu.llm.grpc import kserve_pb2 as pb
-from dynamo_tpu.llm.http.service import _as_output
+from dynamo_tpu.llm.protocols.common import as_engine_output as _as_output
 from dynamo_tpu.llm.protocols import openai as oai
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger

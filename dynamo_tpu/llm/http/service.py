@@ -23,7 +23,7 @@ from aiohttp import web
 
 from dynamo_tpu.llm.discovery import ModelManager
 from dynamo_tpu.llm.protocols import openai as oai
-from dynamo_tpu.llm.protocols.common import LLMEngineOutput
+from dynamo_tpu.llm.protocols.common import LLMEngineOutput, as_engine_output
 from dynamo_tpu.runtime.engine import Annotated, Context
 from dynamo_tpu.runtime.logging import TraceParent, get_logger
 from dynamo_tpu.runtime.metrics import (
@@ -403,14 +403,7 @@ class HttpService:
         return resp
 
 
-def _as_output(item) -> Optional[LLMEngineOutput]:
-    if isinstance(item, Annotated):
-        if item.data is None:
-            return None
-        return LLMEngineOutput.from_wire(item.data)
-    if isinstance(item, dict):
-        return LLMEngineOutput.from_wire(item)
-    return None
+_as_output = as_engine_output
 
 
 async def _sse(resp: web.StreamResponse, obj: dict) -> None:

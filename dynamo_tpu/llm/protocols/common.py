@@ -122,3 +122,18 @@ class LLMEngineOutput:
             tool_calls=d.get("tool_calls"),
             reasoning=d.get("reasoning"),
         )
+
+
+def as_engine_output(item) -> Optional[LLMEngineOutput]:
+    """Normalize a stream item (Annotated wrapper or wire dict) into an
+    LLMEngineOutput; None for pure annotations. Shared by the HTTP and gRPC
+    frontends so the stream-item convention lives in one place."""
+    from dynamo_tpu.runtime.engine import Annotated
+
+    if isinstance(item, Annotated):
+        if item.data is None:
+            return None
+        return LLMEngineOutput.from_wire(item.data)
+    if isinstance(item, dict):
+        return LLMEngineOutput.from_wire(item)
+    return None

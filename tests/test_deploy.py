@@ -1,0 +1,136 @@
+"""Deploy tooling: graph spec, manifest rendering, local operator reconcile.
+Ref: deploy/cloud operator + CRDs (SURVEY.md §2 N12)."""
+
+import asyncio
+import sys
+
+import pytest
+import yaml
+
+from dynamo_tpu.deploy import (
+    GraphConnector,
+    GraphDeployment,
+    LocalOperator,
+    render_manifests,
+)
+from dynamo_tpu.deploy.manifests import render_yaml
+
+GRAPH_YAML = """
+name: tiny-disagg
+namespace: prod
+control_plane: tcp://cp.internal:6650
+services:
+  frontend:
+    command: [python, -m, dynamo_tpu.frontend, --router-mode, kv]
+    replicas: 1
+  decode:
+    command: [python, -m, dynamo_tpu.worker, --model, llama-3-8b]
+    replicas: 2
+    resources: {tpu_chips: 4, memory: 32Gi}
+    env: {BENCH_ATTN: paged_kernel}
+"""
+
+
+def test_spec_yaml_roundtrip():
+    g = GraphDeployment.from_yaml(GRAPH_YAML)
+    assert g.name == "tiny-disagg" and g.namespace == "prod"
+    assert g.services["decode"].replicas == 2
+    assert g.services["decode"].resources.tpu_chips == 4
+    g2 = GraphDeployment.from_yaml(g.to_yaml())
+    assert g2.to_dict() == g.to_dict()
+    env = g.base_env()
+    assert env["DYN_CONTROL_PLANE"] == "tcp"
+    assert env["DYN_CONTROL_PLANE_ADDRESS"] == "cp.internal:6650"
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        GraphDeployment.from_dict({"name": "x", "services": {}})
+    with pytest.raises(ValueError):
+        GraphDeployment.from_dict({"name": "x", "services": {"a": {"replicas": 1}}})
+
+
+def test_render_manifests():
+    g = GraphDeployment.from_yaml(GRAPH_YAML)
+    ms = render_manifests(g, image="gcr.io/p/dynamo-tpu:1", tpu_accelerator="tpu-v5-lite-podslice")
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in ms]
+    assert ("Deployment", "tiny-disagg-frontend") in kinds
+    assert ("Deployment", "tiny-disagg-decode") in kinds
+    assert ("Service", "tiny-disagg-frontend") in kinds  # frontend exposed
+    assert ("Service", "tiny-disagg-decode") not in kinds
+
+    decode = next(m for m in ms if m["metadata"]["name"] == "tiny-disagg-decode")
+    c = decode["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert decode["spec"]["template"]["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"
+    ] == "tpu-v5-lite-podslice"
+    assert decode["spec"]["replicas"] == 2
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["DYN_NAMESPACE"] == "prod" and env["BENCH_ATTN"] == "paged_kernel"
+
+    docs = list(yaml.safe_load_all(render_yaml(g)))
+    assert len(docs) == len(render_manifests(g))
+
+
+def _sleep_graph(replicas=1):
+    return GraphDeployment.from_dict({
+        "name": "t",
+        "services": {
+            "w": {"command": [sys.executable, "-c", "import time; time.sleep(60)"], "replicas": replicas},
+        },
+    })
+
+
+async def test_operator_scale_up_down():
+    op = LocalOperator(_sleep_graph(2), grace_s=2.0)
+    try:
+        await op.reconcile()
+        assert op.status()["w"]["live"] == 2
+        op.set_replicas("w", 1)
+        await op.reconcile()
+        assert op.status()["w"]["live"] == 1
+        conn = GraphConnector(op)
+        await conn.set_replicas("w", 3)
+        assert op.status()["w"]["live"] == 3
+        assert await conn.get_replicas("w") == 3
+    finally:
+        await op.shutdown()
+    assert op.status()["w"]["live"] == 0
+
+
+async def test_operator_restarts_crashed_child():
+    g = GraphDeployment.from_dict({
+        "name": "t",
+        "services": {"w": {"command": [sys.executable, "-c", "pass"], "replicas": 1}},
+    })
+    op = LocalOperator(g, grace_s=1.0, max_restarts=50)
+    try:
+        await op.reconcile()
+        first = op._children["w"][0]
+        await first.proc.wait()  # exits immediately
+        await op.reconcile()  # reaps + respawns
+        assert op.status()["w"]["live"] == 1
+        assert op._children["w"][0] is not first
+    finally:
+        await op.shutdown()
+
+
+async def test_operator_crash_loop_marks_degraded():
+    g = GraphDeployment.from_dict({
+        "name": "t",
+        "services": {"w": {"command": [sys.executable, "-c", "raise SystemExit(1)"], "replicas": 1}},
+    })
+    op = LocalOperator(g, max_restarts=3, restart_window_s=60.0)
+    try:
+        for _ in range(10):
+            await op.reconcile()
+            for c in op._children["w"]:
+                await c.proc.wait()
+            if op.status()["w"]["degraded"]:
+                break
+            await asyncio.sleep(0.02)
+        st = op.status()["w"]
+        assert st["degraded"] and st["live"] == 0  # backs off, stops respawning
+    finally:
+        await op.shutdown()
