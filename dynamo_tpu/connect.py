@@ -1,0 +1,356 @@
+"""Pythonic device-to-device transfer API — the ``dynamo.nixl_connect`` role.
+
+Ref: lib/bindings/python src/dynamo/nixl_connect/__init__.py — ``Connector``
+(:501) with create_readable/create_writable, ``ReadOperation`` (:1273) /
+``WriteOperation``, ``Readable/WritableOperation``, ``Descriptor`` (:723,
+tensor-aware), ``RdmaMetadata`` (:1417). The reference rides NIXL
+(RDMA/NVLink); on TPU hosts the data plane is the runtime's TCP call-home
+stream server (the same wire as response streams and disagg KV pulls), with
+ICI/DCN device transfer as the intra-slice fast path above it.
+
+Rendezvous model (mirrors nixl_connect):
+- One side creates an operation over local buffers and serializes its
+  :class:`RdmaMetadata`, which travels to the peer out-of-band (HTTP body,
+  pubsub message, store key — anything).
+- ``create_readable`` → peer calls ``begin_read(metadata, local_descs)``
+  to pull the buffers. ``create_writable`` → peer calls
+  ``begin_write(local_descs, metadata)`` to push into them.
+- Both sides ``await op.wait_for_completion()``.
+
+``Descriptor`` wraps a numpy array (zero-copy) or a jax array (host
+round-trip on export; ``to_jax()`` re-lands on device after receive).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpCallHome
+
+logger = get_logger(__name__)
+
+_SUBJECT_PREFIX = "connect.read."
+
+
+class TransferError(Exception):
+    pass
+
+
+class Descriptor:
+    """A transferable buffer (ref: nixl_connect Descriptor :723).
+
+    Accepts a numpy array (used in place, received data lands in it
+    zero-copy) or a jax array (copied to host on export; use ``to_jax()``
+    to put received bytes back on device)."""
+
+    def __init__(self, array):
+        import jax
+
+        if isinstance(array, jax.Array):
+            self.device = "tpu" if "tpu" in str(jax.devices()[0]).lower() else str(
+                list(array.devices())[0].platform
+            )
+            self._np = np.asarray(array)  # host copy (device→host DMA)
+        elif isinstance(array, np.ndarray):
+            self.device = "cpu"
+            self._np = array
+        else:
+            raise TypeError(f"Descriptor wants numpy or jax array, got {type(array)}")
+
+    @property
+    def array(self) -> np.ndarray:
+        return self._np
+
+    @property
+    def shape(self):
+        return tuple(self._np.shape)
+
+    @property
+    def dtype(self) -> str:
+        return str(self._np.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return self._np.nbytes
+
+    def meta(self) -> dict:
+        return {"shape": list(self.shape), "dtype": self.dtype}
+
+    def to_jax(self, sharding=None):
+        import jax
+
+        return jax.device_put(self._np, sharding) if sharding is not None else jax.device_put(self._np)
+
+    def _fill(self, raw: bytes, header: dict) -> None:
+        shape, dtype = tuple(header["shape"]), np.dtype(header["dtype"])
+        if shape != self.shape or np.dtype(dtype) != self._np.dtype:
+            raise TransferError(
+                f"descriptor mismatch: got {shape}/{dtype}, want {self.shape}/{self._np.dtype}"
+            )
+        incoming = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        np.copyto(self._np, incoming)
+
+
+class RdmaMetadata:
+    """Serializable rendezvous token (ref: nixl_connect RdmaMetadata :1417)."""
+
+    def __init__(self, kind: str, nonce: str, descriptors: List[dict],
+                 subject: Optional[str] = None, conn: Optional[dict] = None):
+        self.kind = kind  # "readable" | "writable"
+        self.nonce = nonce
+        self.descriptors = descriptors
+        self.subject = subject
+        self.conn = conn
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "kind": self.kind, "nonce": self.nonce, "descriptors": self.descriptors,
+            "subject": self.subject, "conn": self.conn,
+        })
+
+    @classmethod
+    def from_json(cls, raw: Union[str, bytes]) -> "RdmaMetadata":
+        d = json.loads(raw)
+        return cls(d["kind"], d["nonce"], d["descriptors"], d.get("subject"), d.get("conn"))
+
+
+class _Completable:
+    def __init__(self):
+        self._done = asyncio.Event()
+        self._error: Optional[str] = None
+
+    def _complete(self, error: Optional[str] = None) -> None:
+        self._error = error
+        self._done.set()
+
+    async def wait_for_completion(self, timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            await self._done.wait()
+        else:
+            await asyncio.wait_for(self._done.wait(), timeout)
+        if self._error:
+            raise TransferError(self._error)
+
+
+class ReadableOperation(_Completable):
+    """Local buffers a remote may pull (ref: nixl_connect ReadableOperation).
+    Completes after ``remaining_reads`` pulls have been served."""
+
+    def __init__(self, connector: "Connector", descriptors: Sequence[Descriptor], remaining_reads: int):
+        super().__init__()
+        self.connector = connector
+        self.descriptors = list(descriptors)
+        self.nonce = uuid.uuid4().hex
+        self.subject = _SUBJECT_PREFIX + self.nonce
+        self.remaining_reads = remaining_reads
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def _start(self) -> None:
+        self._sub = await self.connector.drt.bus.subscribe(self.subject)
+        self._task = asyncio.get_running_loop().create_task(self._serve())
+
+    async def _serve(self) -> None:
+        served = 0
+        try:
+            async for msg in self._sub:
+                try:
+                    req = json.loads(msg.data)
+                    call_home = TcpCallHome(ConnectionInfo.from_dict(req["conn"]))
+                    if not await call_home.connect():
+                        continue
+                    try:
+                        for i, d in enumerate(self.descriptors):
+                            await call_home.send(
+                                {"seq": i, "total": len(self.descriptors), **d.meta()},
+                                d.array.tobytes(),
+                            )
+                        await call_home.complete()
+                    finally:
+                        await call_home.close()
+                    served += 1
+                    if served >= self.remaining_reads:
+                        self._complete()
+                        return
+                except (ConnectionError, OSError, ValueError, KeyError) as e:
+                    logger.warning("readable %s: serve failed: %s", self.nonce, e)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            # Drop the broker subscription whether we completed, were
+            # cancelled, or the subscription closed — a long-lived worker
+            # creates one op per transfer and must not leak subscribers.
+            if self._sub is not None:
+                await self._sub.unsubscribe()
+                self._sub = None
+
+    def metadata(self) -> RdmaMetadata:
+        return RdmaMetadata(
+            "readable", self.nonce, [d.meta() for d in self.descriptors], subject=self.subject
+        )
+
+    async def cancel(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+            self._sub = None
+        if not self._done.is_set():
+            self._complete("cancelled")
+
+
+class WritableOperation(_Completable):
+    """Local buffers a remote will push into (ref: WritableOperation)."""
+
+    def __init__(self, connector: "Connector", descriptors: Sequence[Descriptor]):
+        super().__init__()
+        self.connector = connector
+        self.descriptors = list(descriptors)
+        self.nonce = uuid.uuid4().hex
+        self.conn_info, self._pending = connector.drt.tcp_server_handle().register()
+        self._task: Optional[asyncio.Task] = None
+
+    async def _start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._receive())
+
+    async def _receive(self) -> None:
+        try:
+            async for frame in self._pending.frames():
+                if frame.kind == "data":
+                    seq = int(frame.header["seq"])
+                    if not 0 <= seq < len(self.descriptors):
+                        self._complete(f"bad descriptor index {seq}")
+                        return
+                    self.descriptors[seq]._fill(frame.body, frame.header)
+                elif frame.kind == "error":
+                    self._complete(frame.header.get("message", "write failed"))
+                    return
+            self._complete()
+        except (TransferError, ValueError, KeyError, TypeError) as e:
+            # Malformed frame or unwritable destination: the op must still
+            # complete (with the error) or waiters hang forever.
+            self._complete(str(e))
+        finally:
+            self.connector.drt.tcp_server_handle().unregister(self.conn_info.stream_id)
+
+    def metadata(self) -> RdmaMetadata:
+        return RdmaMetadata(
+            "writable", self.nonce, [d.meta() for d in self.descriptors],
+            conn=self.conn_info.to_dict(),
+        )
+
+
+class ReadOperation(_Completable):
+    """Pull a remote readable's buffers into local descriptors."""
+
+    def __init__(self, connector: "Connector", metadata: RdmaMetadata, descriptors: Sequence[Descriptor]):
+        super().__init__()
+        if metadata.kind != "readable":
+            raise ValueError("begin_read needs metadata from a ReadableOperation")
+        self.connector = connector
+        self.metadata_ = metadata
+        self.descriptors = list(descriptors)
+        self._task: Optional[asyncio.Task] = None
+
+    async def _start(self) -> None:
+        conn_info, pending = self.connector.drt.tcp_server_handle().register()
+        await self.connector.drt.bus.publish(
+            self.metadata_.subject, json.dumps({"conn": conn_info.to_dict()}).encode()
+        )
+
+        async def receive():
+            try:
+                async for frame in pending.frames():
+                    if frame.kind == "data":
+                        seq = int(frame.header["seq"])
+                        if not 0 <= seq < len(self.descriptors):
+                            self._complete(f"bad descriptor index {seq}")
+                            return
+                        self.descriptors[seq]._fill(frame.body, frame.header)
+                    elif frame.kind == "error":
+                        self._complete(frame.header.get("message", "read failed"))
+                        return
+                self._complete()
+            except (TransferError, ValueError, KeyError, TypeError) as e:
+                self._complete(str(e))
+            finally:
+                self.connector.drt.tcp_server_handle().unregister(conn_info.stream_id)
+
+        self._task = asyncio.get_running_loop().create_task(receive())
+
+
+class WriteOperation(_Completable):
+    """Push local descriptors into a remote writable."""
+
+    def __init__(self, connector: "Connector", descriptors: Sequence[Descriptor], metadata: RdmaMetadata):
+        super().__init__()
+        if metadata.kind != "writable":
+            raise ValueError("begin_write needs metadata from a WritableOperation")
+        self.connector = connector
+        self.metadata_ = metadata
+        self.descriptors = list(descriptors)
+        self._task: Optional[asyncio.Task] = None
+
+    async def _start(self) -> None:
+        async def push():
+            call_home = TcpCallHome(ConnectionInfo.from_dict(self.metadata_.conn))
+            try:
+                if not await call_home.connect():
+                    self._complete("remote writable rejected connection")
+                    return
+                for i, d in enumerate(self.descriptors):
+                    await call_home.send(
+                        {"seq": i, "total": len(self.descriptors), **d.meta()}, d.array.tobytes()
+                    )
+                await call_home.complete()
+                self._complete()
+            except (ConnectionError, OSError) as e:
+                self._complete(f"write failed: {e}")
+            finally:
+                await call_home.close()
+
+        self._task = asyncio.get_running_loop().create_task(push())
+
+
+class Connector:
+    """Factory bound to a DistributedRuntime (ref: nixl_connect Connector)."""
+
+    def __init__(self, drt):
+        self.drt = drt
+
+    async def create_readable(
+        self, *descriptors: Descriptor, remaining_reads: int = 1
+    ) -> ReadableOperation:
+        op = ReadableOperation(self, descriptors, remaining_reads)
+        await op._start()
+        return op
+
+    async def create_writable(self, *descriptors: Descriptor) -> WritableOperation:
+        op = WritableOperation(self, descriptors)
+        await op._start()
+        return op
+
+    async def begin_read(
+        self, metadata: Union[RdmaMetadata, str, bytes], *descriptors: Descriptor
+    ) -> ReadOperation:
+        if not isinstance(metadata, RdmaMetadata):
+            metadata = RdmaMetadata.from_json(metadata)
+        op = ReadOperation(self, metadata, descriptors)
+        await op._start()
+        return op
+
+    async def begin_write(
+        self, metadata: Union[RdmaMetadata, str, bytes], *descriptors: Descriptor
+    ) -> WriteOperation:
+        if not isinstance(metadata, RdmaMetadata):
+            metadata = RdmaMetadata.from_json(metadata)
+        op = WriteOperation(self, descriptors, metadata)
+        await op._start()
+        return op

@@ -32,13 +32,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def _run(graph: GraphDeployment, interval: float) -> None:
+    import signal
+
     op = LocalOperator(graph)
     op.start(interval_s=interval)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    # SIGTERM (systemd/k8s stop) must drain children, same as ctrl-c.
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
     try:
-        while True:
-            await asyncio.sleep(3600)
-    except asyncio.CancelledError:
-        pass
+        await stop.wait()
     finally:
         await op.shutdown()
 

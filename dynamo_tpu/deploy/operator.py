@@ -23,7 +23,6 @@ from __future__ import annotations
 import asyncio
 import os
 import signal
-import sys
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -93,6 +92,8 @@ class LocalOperator:
     # --- reconcile ----------------------------------------------------------
     async def reconcile(self) -> None:
         async with self._lock:
+            if self._stop.is_set():
+                return  # shutting down: no further spawns
             for name, spec in self.graph.services.items():
                 try:
                     await self._reconcile_service(name, spec)
@@ -127,12 +128,10 @@ class LocalOperator:
     async def _spawn(self, service: str) -> _Child:
         spec = self.graph.services[service]
         env = {**os.environ, **self.graph.base_env(), **spec.env}
-        proc = await asyncio.create_subprocess_exec(
-            *spec.command,
-            env=env,
-            stdout=sys.stdout if sys.stdout.isatty() else asyncio.subprocess.DEVNULL,
-            stderr=sys.stderr if sys.stderr.isatty() else asyncio.subprocess.DEVNULL,
-        )
+        # Children inherit our stdout/stderr: under systemd or piped logging
+        # the workers' output flows through the supervisor's redirection
+        # instead of vanishing into DEVNULL.
+        proc = await asyncio.create_subprocess_exec(*spec.command, env=env)
         logger.info("%s/%s spawned pid=%d", self.graph.name, service, proc.pid)
         return _Child(proc=proc)
 
@@ -167,9 +166,12 @@ class LocalOperator:
         if self._task is not None:
             await self._task
             self._task = None
-        for name, children in self._children.items():
-            await asyncio.gather(*(self._terminate(name, c) for c in children))
-            children.clear()
+        # Under the lock: a concurrent planner-driven reconcile must not
+        # respawn children we are terminating.
+        async with self._lock:
+            for name, children in self._children.items():
+                await asyncio.gather(*(self._terminate(name, c) for c in children))
+                children.clear()
 
 
 class GraphConnector(Connector):

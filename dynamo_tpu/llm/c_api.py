@@ -1,0 +1,94 @@
+"""C ABI bridge: native-runtime KV event publishing.
+
+Ref: lib/bindings/c/src/lib.rs (326 LoC) — `dynamo_llm_init/shutdown` and
+the KV-event publish FFI the reference exposes so TRT-LLM's C++ runtime can
+feed the KV router without crossing into Rust-managed async. Here the same
+role: a C++ component (custom data loader, native engine runtime) calls the
+``extern "C"`` functions in the dynamo_tpu_native extension —
+
+    int dynamo_tpu_llm_init(void);
+    int dynamo_tpu_llm_shutdown(void);
+    int dynamo_tpu_kv_event_publish_stored(uint64_t worker_id,
+        const uint64_t* hashes, size_t n, uint64_t parent, int has_parent);
+    int dynamo_tpu_kv_event_publish_removed(uint64_t worker_id,
+        const uint64_t* hashes, size_t n);
+
+— without holding the GIL; events land in a mutex-guarded queue inside the
+extension, and :class:`NativeKvEventSource` pumps them into the normal
+``KvEventPublisher`` → router path.
+
+``load_c_abi()`` returns a ctypes handle to the same functions (what an
+out-of-process C client would dlopen), used by tests and as API reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+from typing import Optional
+
+from dynamo_tpu.engine.kv_cache import KvEvent
+from dynamo_tpu.native import get_native
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def load_c_abi() -> ctypes.CDLL:
+    """ctypes handle to the extension's C ABI (raises if not built)."""
+    native = get_native()
+    if native is None:
+        raise RuntimeError("dynamo_tpu_native extension is not available")
+    lib = ctypes.CDLL(native.__file__)
+    lib.dynamo_tpu_llm_init.restype = ctypes.c_int
+    lib.dynamo_tpu_llm_shutdown.restype = ctypes.c_int
+    lib.dynamo_tpu_kv_event_publish_stored.restype = ctypes.c_int
+    lib.dynamo_tpu_kv_event_publish_stored.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+        ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dynamo_tpu_kv_event_publish_removed.restype = ctypes.c_int
+    lib.dynamo_tpu_kv_event_publish_removed.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+    ]
+    return lib
+
+
+class NativeKvEventSource:
+    """Pump C-ABI-queued KV events into a KvEventPublisher."""
+
+    def __init__(self, publisher, poll_interval_s: float = 0.05):
+        self.publisher = publisher
+        self.poll_interval_s = poll_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.events_pumped = 0
+
+    def start(self) -> None:
+        native = get_native()
+        if native is None or not hasattr(native, "drain_kv_events"):
+            raise RuntimeError("dynamo_tpu_native extension with KV event ABI not available")
+        self._native = native
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            for ev in self._native.drain_kv_events():
+                self.publisher.publish(
+                    KvEvent(
+                        kind=ev["kind"],
+                        block_hashes=ev["block_hashes"],
+                        parent_hash=ev["parent_hash"],
+                    )
+                )
+                self.events_pumped += 1
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=self.poll_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None

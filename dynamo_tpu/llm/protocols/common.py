@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from dynamo_tpu.runtime.engine import Annotated
+
 
 @dataclass
 class SamplingOptions:
@@ -128,8 +130,6 @@ def as_engine_output(item) -> Optional[LLMEngineOutput]:
     """Normalize a stream item (Annotated wrapper or wire dict) into an
     LLMEngineOutput; None for pure annotations. Shared by the HTTP and gRPC
     frontends so the stream-item convention lives in one place."""
-    from dynamo_tpu.runtime.engine import Annotated
-
     if isinstance(item, Annotated):
         if item.data is None:
             return None

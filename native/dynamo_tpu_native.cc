@@ -24,6 +24,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -418,23 +419,147 @@ static PyTypeObject RadixTreeType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI: KV event publishing for external native runtimes
+// (ref: lib/bindings/c/src/lib.rs — dynamo_llm_init/shutdown + KV event
+// publish FFI used by the TRT-LLM C++ runtime). A native component (data
+// loader, custom engine runtime) calls these extern "C" functions WITHOUT
+// holding the GIL; events land in a mutex-guarded queue the Python
+// KvEventPublisher drains (drain_kv_events below).
+// ---------------------------------------------------------------------------
+
+struct CKvEvent {
+  uint64_t worker_id;
+  int kind;  // 0 = stored, 1 = removed
+  std::vector<uint64_t> hashes;
+  uint64_t parent;  // meaningful iff has_parent
+  bool has_parent;
+};
+
+static std::mutex g_kv_events_mu;
+static std::vector<CKvEvent> g_kv_events;
+static bool g_kv_initialized = false;
+// Bounded: if the Python drainer is not running, publishes are dropped (and
+// counted) instead of growing the queue without limit.
+static const size_t kKvEventQueueCap = 65536;
+static uint64_t g_kv_events_dropped = 0;
+
+extern "C" {
+
+#define DYN_EXPORT __attribute__((visibility("default")))
+
+// Returns 0 on success. Idempotent.
+DYN_EXPORT int dynamo_tpu_llm_init(void) {
+  std::lock_guard<std::mutex> lock(g_kv_events_mu);
+  g_kv_initialized = true;
+  return 0;
+}
+
+DYN_EXPORT int dynamo_tpu_llm_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_kv_events_mu);
+  g_kv_initialized = false;
+  g_kv_events.clear();
+  return 0;
+}
+
+// hashes: array of n chained block hashes; parent: hash of the block
+// preceding hashes[0], or pass has_parent=0 for a sequence head.
+DYN_EXPORT int dynamo_tpu_kv_event_publish_stored(uint64_t worker_id, const uint64_t* hashes,
+                                       size_t n, uint64_t parent, int has_parent) {
+  std::lock_guard<std::mutex> lock(g_kv_events_mu);
+  if (!g_kv_initialized) return -1;
+  if (g_kv_events.size() >= kKvEventQueueCap) {
+    g_kv_events_dropped++;
+    return -2;
+  }
+  CKvEvent ev;
+  ev.worker_id = worker_id;
+  ev.kind = 0;
+  ev.hashes.assign(hashes, hashes + n);
+  ev.parent = parent;
+  ev.has_parent = has_parent != 0;
+  g_kv_events.push_back(std::move(ev));
+  return 0;
+}
+
+DYN_EXPORT int dynamo_tpu_kv_event_publish_removed(uint64_t worker_id, const uint64_t* hashes,
+                                        size_t n) {
+  std::lock_guard<std::mutex> lock(g_kv_events_mu);
+  if (!g_kv_initialized) return -1;
+  if (g_kv_events.size() >= kKvEventQueueCap) {
+    g_kv_events_dropped++;
+    return -2;
+  }
+  CKvEvent ev;
+  ev.worker_id = worker_id;
+  ev.kind = 1;
+  ev.hashes.assign(hashes, hashes + n);
+  ev.parent = 0;
+  ev.has_parent = false;
+  g_kv_events.push_back(std::move(ev));
+  return 0;
+}
+
+}  // extern "C"
+
+// drain_kv_events() -> list[dict] — Python-side pump into KvEventPublisher.
+static PyObject* py_drain_kv_events(PyObject*, PyObject*) {
+  std::vector<CKvEvent> drained;
+  {
+    std::lock_guard<std::mutex> lock(g_kv_events_mu);
+    drained.swap(g_kv_events);
+  }
+  PyObject* out = PyList_New((Py_ssize_t)drained.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < drained.size(); i++) {
+    const CKvEvent& ev = drained[i];
+    PyObject* hashes = PyList_New((Py_ssize_t)ev.hashes.size());
+    if (!hashes) { Py_DECREF(out); return nullptr; }
+    for (size_t j = 0; j < ev.hashes.size(); j++) {
+      PyList_SET_ITEM(hashes, (Py_ssize_t)j,
+                      PyLong_FromUnsignedLongLong(ev.hashes[j]));
+    }
+    PyObject* parent = ev.has_parent
+        ? PyLong_FromUnsignedLongLong(ev.parent)
+        : (Py_INCREF(Py_None), Py_None);
+    PyObject* d = Py_BuildValue(
+        "{s:K, s:s, s:N, s:N}",
+        "worker_id", (unsigned long long)ev.worker_id,
+        "kind", ev.kind == 0 ? "stored" : "removed",
+        "block_hashes", hashes,
+        "parent_hash", parent);
+    if (!d) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, d);
+  }
+  return out;
+}
+
+static PyObject* py_kv_events_dropped(PyObject*, PyObject*) {
+  std::lock_guard<std::mutex> lock(g_kv_events_mu);
+  return PyLong_FromUnsignedLongLong(g_kv_events_dropped);
+}
+
 static PyMethodDef module_methods[] = {
     {"hash_tokens", py_hash_tokens, METH_VARARGS,
      "hash_tokens(tokens, seed) -> u64 (xxh3_64 over LE u32 ids)"},
     {"hash_token_blocks", py_hash_token_blocks, METH_VARARGS,
      "hash_token_blocks(tokens, block_size, seed) -> list[u64] (chained)"},
+    {"drain_kv_events", py_drain_kv_events, METH_NOARGS,
+     "drain_kv_events() -> list[dict] — pop events queued via the C ABI"},
+    {"kv_events_dropped", py_kv_events_dropped, METH_NOARGS,
+     "kv_events_dropped() -> int — publishes rejected because the queue was full"},
     {nullptr, nullptr, 0, nullptr},
 };
 
 static struct PyModuleDef native_module = {
     PyModuleDef_HEAD_INIT,
     "dynamo_tpu_native",
-    "C++ hot paths: token hashing + radix-tree prefix indexer",
+    "C++ hot paths: token hashing + radix-tree prefix indexer + KV event C ABI",
     -1,
     module_methods,
 };
-
-}  // namespace
 
 PyMODINIT_FUNC PyInit_dynamo_tpu_native(void) {
   RadixTreeType.tp_name = "dynamo_tpu_native.RadixTree";

@@ -1,0 +1,80 @@
+"""C ABI KV-event bridge (ref: lib/bindings/c/src/lib.rs)."""
+
+import asyncio
+import ctypes
+import json
+
+import pytest
+
+from dynamo_tpu.native import available, get_native
+
+pytestmark = pytest.mark.skipif(not available(), reason="native extension not built")
+
+
+def _publish_via_ctypes(lib, worker_id, hashes, parent=None):
+    arr = (ctypes.c_uint64 * len(hashes))(*hashes)
+    return lib.dynamo_tpu_kv_event_publish_stored(
+        worker_id, arr, len(hashes), parent or 0, 1 if parent is not None else 0
+    )
+
+
+def test_c_abi_publish_and_drain():
+    from dynamo_tpu.llm.c_api import load_c_abi
+
+    lib = load_c_abi()
+    assert lib.dynamo_tpu_llm_init() == 0
+    try:
+        assert _publish_via_ctypes(lib, 7, [11, 22, 33], parent=5) == 0
+        arr = (ctypes.c_uint64 * 2)(22, 33)
+        assert lib.dynamo_tpu_kv_event_publish_removed(7, arr, 2) == 0
+
+        native = get_native()
+        evs = native.drain_kv_events()
+        assert len(evs) == 2
+        assert evs[0] == {"worker_id": 7, "kind": "stored", "block_hashes": [11, 22, 33], "parent_hash": 5}
+        assert evs[1]["kind"] == "removed" and evs[1]["parent_hash"] is None
+        assert native.drain_kv_events() == []  # drained
+    finally:
+        assert lib.dynamo_tpu_llm_shutdown() == 0
+
+
+def test_c_abi_requires_init():
+    from dynamo_tpu.llm.c_api import load_c_abi
+
+    lib = load_c_abi()
+    lib.dynamo_tpu_llm_shutdown()
+    assert _publish_via_ctypes(lib, 1, [1]) == -1  # not initialized
+
+
+async def test_native_events_pump_to_router_stream():
+    """C ABI → NativeKvEventSource → KvEventPublisher → durable stream."""
+    from dynamo_tpu.llm.c_api import NativeKvEventSource, load_c_abi
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, kv_events_stream_name
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    lib = load_c_abi()
+    lib.dynamo_tpu_llm_init()
+    try:
+        pub = KvEventPublisher(drt, "ns", "backend", worker_id=9)
+        pub.start()
+        source = NativeKvEventSource(pub, poll_interval_s=0.02)
+        source.start()
+
+        _publish_via_ctypes(lib, 9, [101, 102])
+        for _ in range(100):
+            if source.events_pumped >= 1:
+                break
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.1)  # let the publisher drain to the stream
+        await source.stop()
+        await pub.stop()
+
+        stream = await drt.bus.stream(kv_events_stream_name("ns", "backend"))
+        msgs = await stream.fetch(1)
+        assert len(msgs) >= 1
+        payload = json.loads(msgs[0].data)
+        assert payload["block_hashes"] == [101, 102] and payload["worker_id"] == 9
+    finally:
+        lib.dynamo_tpu_llm_shutdown()
+        await drt.shutdown()
