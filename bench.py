@@ -27,7 +27,7 @@ def main() -> None:
 
     model = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
     batch = int(os.environ.get("BENCH_BATCH", "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "256"))
     ctx_len = int(os.environ.get("BENCH_CTX", "1024"))
 
     attn = os.environ.get("BENCH_ATTN", "auto")  # auto|gather|paged_kernel
@@ -37,14 +37,30 @@ def main() -> None:
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
 
-    max_blocks = cfg.max_seq_len // cfg.block_size
+    # Width bucketed like the scheduler: 16-block granularity over the FULL
+    # run's final context (ctx + all generated steps), so every window's
+    # positions stay inside the table.
+    window_env = int(os.environ.get("BENCH_WINDOW", "8"))
+    needed = (ctx_len + steps + 1 + cfg.block_size - 1) // cfg.block_size
+    round_to = int(os.environ.get("BENCH_WIDTH_ROUND", "16"))
+    max_blocks = min((needed + round_to - 1) // round_to * round_to, cfg.max_seq_len // cfg.block_size)
     tables = jnp.tile(jnp.arange(1, max_blocks + 1, dtype=jnp.int32)[None, :], (batch, 1))
     # Distinct blocks per sequence (wrap within pool to stay allocated).
     tables = (tables + jnp.arange(batch, dtype=jnp.int32)[:, None] * (ctx_len // cfg.block_size)) % (num_blocks - 1) + 1
     active = jnp.ones((batch,), dtype=bool)
 
-    decode = jax.jit(
-        lambda p, k, v, t, pos: llama.decode(p, cfg, k, v, t, pos, tables, active),
+    # Multi-step windows (scheduler num_scheduler_steps): the sample→embed
+    # feedback loop stays on device, so dispatch overhead amortizes over
+    # `window` tokens — the production decode path, not a synthetic loop.
+    window = window_env
+    greedy = jnp.zeros((batch,), jnp.float32)
+    top_k = jnp.zeros((batch,), jnp.int32)
+    top_p = jnp.ones((batch,), jnp.float32)
+
+    decode_window = jax.jit(
+        lambda p, k, v, t, pos, key: llama.decode_multi(
+            p, cfg, k, v, t, pos, tables, active, greedy, top_k, top_p, key, window
+        ),
         donate_argnums=(1, 2),
     )
 
@@ -53,14 +69,16 @@ def main() -> None:
     k, v = cache.k, cache.v
 
     # Warmup / compile.
-    logits, k, v = decode(params, k, v, toks, pos)
-    logits.block_until_ready()
+    out, k, v = decode_window(params, k, v, toks, pos, jax.random.PRNGKey(0))
+    out.block_until_ready()
 
+    n_windows = max(1, steps // window)
     t0 = time.perf_counter()
-    for i in range(steps):
-        logits, k, v = decode(params, k, v, toks, pos + i)
-    logits.block_until_ready()
+    for i in range(n_windows):
+        out, k, v = decode_window(params, k, v, toks, pos + i * window, jax.random.PRNGKey(i))
+    out.block_until_ready()
     dt = time.perf_counter() - t0
+    steps = n_windows * window
 
     step_ms = dt / steps * 1000
     tok_s_per_user = 1.0 / (dt / steps)  # one token per user per step

@@ -57,47 +57,65 @@ def _decode_kernel(
     # outputs
     out_ref,  # VMEM [1, KVH*G, KVH*HD]
     # scratch
-    k_buf,  # VMEM [2, BS, KVH*HD]
-    v_buf,  # VMEM [2, BS, KVH*HD]
-    sems,  # DMA sems [2, 2]
+    k_buf,  # VMEM [2, STRIP*BS, KVH*HD]
+    v_buf,  # VMEM [2, STRIP*BS, KVH*HD]
+    sems,  # DMA sems [2, STRIP, 2]
     *,
     block_size: int,
     scale: float,
+    strip: int,
 ):
+    """Pages are processed in strips of ``strip`` pages per loop iteration:
+    one 16-token page is a ~16 KB DMA (latency-bound) and a [rows, 16]
+    matmul (MXU-starved); a strip amortizes DMA issue latency over
+    strip× the bytes and widens the matmuls to [rows, strip*BS]."""
     b = pl.program_id(0)
     kv_len = lens_ref[b]
-    n_pages = pl.cdiv(kv_len, block_size)
+    bs = block_size
+    n_pages = pl.cdiv(kv_len, bs)
+    n_strips = pl.cdiv(n_pages, strip)
 
     rows = w_ref.shape[2]  # KVH*G
     merged = w_ref.shape[1]  # KVH*HD
-    bs = block_size
 
-    def page_dma(slot, page_idx):
-        block_id = tables_ref[b, page_idx]
-        k_dma = pltpu.make_async_copy(k_hbm.at[block_id], k_buf.at[slot], sems.at[slot, 0])
-        v_dma = pltpu.make_async_copy(v_hbm.at[block_id], v_buf.at[slot], sems.at[slot, 1])
-        return k_dma, v_dma
+    def strip_dma(slot, strip_idx):
+        """Issue up to ``strip`` page-pair DMAs into the slot's buffer."""
+        dmas = []
+        for j in range(strip):  # static unroll
+            page_idx = strip_idx * strip + j
+            # Clamp: tail strips re-read page 0 into lanes that the score
+            # mask then discards — cheaper than a dynamic DMA count.
+            safe_idx = jnp.where(page_idx < n_pages, page_idx, 0)
+            block_id = tables_ref[b, safe_idx]
+            dmas.append(pltpu.make_async_copy(
+                k_hbm.at[block_id], k_buf.at[slot, pl.ds(j * bs, bs)], sems.at[slot, j, 0]
+            ))
+            dmas.append(pltpu.make_async_copy(
+                v_hbm.at[block_id], v_buf.at[slot, pl.ds(j * bs, bs)], sems.at[slot, j, 1]
+            ))
+        return dmas
 
     @pl.when(kv_len > 0)
     def _():
-        for dma in page_dma(0, 0):
+        for dma in strip_dma(0, 0):
             dma.start()
 
     w = w_ref[0]  # [KVH*HD, KVH*G]
+    span = strip * bs
 
     def body(i, carry):
         m, l, acc = carry
         slot = lax.rem(i, 2)
 
-        @pl.when(i + 1 < n_pages)
+        @pl.when(i + 1 < n_strips)
         def _():
-            for dma in page_dma(lax.rem(i + 1, 2), i + 1):
+            for dma in strip_dma(lax.rem(i + 1, 2), i + 1):
                 dma.start()
 
-        for dma in page_dma(slot, i):
+        for dma in strip_dma(slot, i):
             dma.wait()
 
-        k = k_buf[slot]  # [BS, KVH*HD]
+        k = k_buf[slot]  # [STRIP*BS, KVH*HD]
         v = v_buf[slot]
 
         # scores[r, s] = Σ_c w[c, r] · k[s, c] — GQA scores for row r=(kvh,g):
@@ -106,14 +124,14 @@ def _decode_kernel(
             w, k,
             dimension_numbers=(((0,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [KVH*G, BS]
+        ) * scale  # [KVH*G, STRIP*BS]
 
-        key_pos = i * bs + lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        key_pos = i * span + lax.broadcasted_iota(jnp.int32, (rows, span), 1)
         scores = jnp.where(key_pos < kv_len, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))  # [rows, 1]
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)  # [rows, BS]
+        p = jnp.exp(scores - m_new)  # [rows, STRIP*BS]
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
 
         # out_m[r, c] += Σ_s p[r, s] · v[s, c]
@@ -128,13 +146,13 @@ def _decode_kernel(
     m0 = jnp.full((rows, 1), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((rows, 1), dtype=jnp.float32)
     acc0 = jnp.zeros((rows, merged), dtype=jnp.float32)
-    m, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(0, n_strips, body, (m0, l0, acc0))
 
     l_safe = jnp.where(l > 0.0, l, 1.0)
     out_ref[0] = (acc / l_safe).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "pages_per_strip"))
 def paged_decode_attention(
     q: jax.Array,  # [B, H, HD]
     k_cache: jax.Array,  # [N, BS, KVH, HD]
@@ -144,6 +162,7 @@ def paged_decode_attention(
     *,
     block_size: int,
     interpret: bool = False,
+    pages_per_strip: int = 16,
 ) -> jax.Array:
     """Single decode-step attention over the paged KV cache → [B, H, HD]."""
     B, H, HD = q.shape
@@ -151,6 +170,7 @@ def paged_decode_attention(
     G = H // KVH
     merged = KVH * HD
     rows = KVH * G
+    strip = max(1, min(pages_per_strip, block_tables.shape[1]))
 
     # Block-diagonal fold: W[b, kvh*HD+d, kvh*G+g] = q[b, kvh, g, d].
     q5 = q.reshape(B, KVH, G, HD)
@@ -171,14 +191,14 @@ def paged_decode_attention(
         ],
         out_specs=pl.BlockSpec((1, rows, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, BS, merged), k_cache.dtype),
-            pltpu.VMEM((2, BS, merged), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, strip * BS, merged), k_cache.dtype),
+            pltpu.VMEM((2, strip * BS, merged), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, strip, 2)),
         ],
     )
 
     out_m = pl.pallas_call(
-        functools.partial(_decode_kernel, block_size=block_size, scale=HD**-0.5),
+        functools.partial(_decode_kernel, block_size=block_size, scale=HD**-0.5, strip=strip),
         out_shape=jax.ShapeDtypeStruct((B, rows, merged), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -186,8 +186,11 @@ def prefill(
     total = cache_len + valid_len
     mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)  # [T, ctx]
 
-    def layer_fn(h, xs):
-        lp, kc, vc = xs  # kc: [N, BS, KVH, HD]
+    # Cache as scan carry (see decode_layer_scan): avoids materializing a
+    # fresh full-cache pair per chunk.
+    def layer_fn(carry, xs):
+        h, kc, vc = carry
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
@@ -195,19 +198,24 @@ def prefill(
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
-        kc = kc.at[tgt_blocks, tgt_offs].set(k)
-        vc = vc.at[tgt_blocks, tgt_offs].set(v)
+        kc = kc.at[l, tgt_blocks, tgt_offs].set(k)
+        vc = vc.at[l, tgt_blocks, tgt_offs].set(v)
+        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+        vl = lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
 
-        k_ctx = kc[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
-        v_ctx = vc[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
+        k_ctx = kl[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
+        v_ctx = vl[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
         attn = _attend(q, k_ctx, v_ctx, mask, c)
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return h, (kc, vc)
+        return (h, kc, vc), None
 
-    h, (k_new, v_new) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
+    (h, k_new, v_new), _ = lax.scan(
+        layer_fn, (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
+    )
 
     head = params.get("lm_head")
     if all_logits:
@@ -218,6 +226,48 @@ def prefill(
     h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
     logits = h_last @ (head if head is not None else params["embed"].T)
     return logits.astype(jnp.float32), k_new, v_new
+
+
+def decode_multi(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B] current token per sequence
+    positions: jax.Array,  # [B] write slot of the current token
+    block_tables: jax.Array,  # [B, max_blocks] — must cover positions+num_steps
+    active: jax.Array,  # [B] bool
+    temps: jax.Array,  # [B] f32 (0 = greedy)
+    top_ks: jax.Array,  # [B] i32 (0 = off)
+    top_ps: jax.Array,  # [B] f32 (1 = off)
+    rng_key: jax.Array,
+    num_steps: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``num_steps`` autoregressive decode steps + on-device sampling in ONE
+    compiled dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache).
+
+    The TPU-native answer to per-step dispatch overhead (the reference's
+    engines expose the same lever as vLLM ``--num-scheduler-steps``): the
+    sample→embed feedback loop stays on device, so the host syncs once per
+    window instead of once per token. Stop conditions are checked on the
+    host afterwards; tokens past a stop are trimmed by the scheduler."""
+    from dynamo_tpu.engine.sampling import sample_batch
+
+    B = tokens.shape[0]
+
+    def body(i, state):
+        toks, poss, kc, vc, out, key = state
+        logits, kc, vc = decode(params, config, kc, vc, toks, poss, block_tables, active)
+        key, sub = jax.random.split(key)
+        nxt = sample_batch(logits, temps, top_ks, top_ps, sub).astype(jnp.int32)
+        out = out.at[i].set(nxt)
+        return (nxt, poss + 1, kc, vc, out, key)
+
+    out = jnp.zeros((num_steps, B), dtype=jnp.int32)
+    _, _, k_new, v_new, out, _ = lax.fori_loop(
+        0, num_steps, body, (tokens, positions, k_cache, v_cache, out, rng_key)
+    )
+    return out, k_new, v_new
 
 
 def embed(
@@ -297,13 +347,21 @@ def decode_layer_scan(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decode layer body over a stacked layer group. Factored out of
     ``decode`` so pipeline parallelism (pipeline_parallel.py) can run the
-    same body on each stage's local L/pp slice of layers + KV cache."""
+    same body on each stage's local L/pp slice of layers + KV cache.
+
+    The KV cache rides the scan CARRY (updated per layer with a dynamic
+    index), not the xs/ys stream: stacked ys would make XLA materialize a
+    fresh full-cache pair every step (~2× cache bytes of extra HBM traffic
+    per token — measured 13.3→8.4 ms/step on llama-3.2-1b, v5e), whereas a
+    carried buffer donates through in place."""
     B = h.shape[0]
     bs = c.block_size
     ctx = block_tables.shape[1] * bs
+    L = k_cache.shape[0]
 
-    def layer_fn(h, xs):
-        lp, kc, vc = xs
+    def layer_fn(carry, xs):
+        h, kc, vc = carry
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
@@ -312,19 +370,21 @@ def decode_layer_scan(
         k = apply_rope(k, positions[:, None], c.rope_theta)[:, 0]
         v = v[:, 0]
 
-        kc = kc.at[tgt_blocks, tgt_offs].set(k)
-        vc = vc.at[tgt_blocks, tgt_offs].set(v)
+        kc = kc.at[l, tgt_blocks, tgt_offs].set(k)
+        vc = vc.at[l, tgt_blocks, tgt_offs].set(v)
+        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)  # [N, BS, KVH, HD]
+        vl = lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
 
         if use_kernel:
             from dynamo_tpu.engine.attention.paged import paged_decode_attention
 
             attn = paged_decode_attention(
-                q, kc, vc, block_tables, kv_lens,
+                q, kl, vl, block_tables, kv_lens,
                 block_size=bs, interpret=jax.default_backend() != "tpu",
             )  # [B, H, hd]
         else:
-            k_ctx = kc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
-            v_ctx = vc[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            k_ctx = kl[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            v_ctx = vl[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
             attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
                 q, k_ctx, v_ctx, mask
             )  # [B, H, hd]
@@ -332,9 +392,11 @@ def decode_layer_scan(
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return h, (kc, vc)
+        return (h, kc, vc), None
 
-    h, (k_new, v_new) = lax.scan(layer_fn, h, (layers, k_cache, v_cache))
+    (h, k_new, v_new), _ = lax.scan(
+        layer_fn, (h, k_cache, v_cache), (layers, jnp.arange(L, dtype=jnp.int32))
+    )
     return h, k_new, v_new
 
 
@@ -356,15 +418,17 @@ def decode(
 
     tgt_blocks, tgt_offs, mask = decode_targets(positions, block_tables, active, bs)
 
-    # "auto" only picks the kernel single-chip (under a GSPMD mesh the
-    # pallas_call would need a shard_map wrapper; the gather path partitions
-    # fine) and only when KV pages are Mosaic-DMA-aligned: lane dim
-    # KVH*HD % 128, sublane BS % 8 (tiny test configs fall back to gather).
+    # "auto" uses the XLA gather: measured on v5e (llama-3.2-1b, b8,
+    # ctx1024) it beats the Pallas kernel ~3× at equal effective context —
+    # XLA's fused gather+batched-matmul pipelines better than a per-sequence
+    # serial-grid kernel, and the scheduler's width bucketing keeps the
+    # gather close to the real context length. The kernel stays available
+    # (attention_impl="paged_kernel") for very long, fragmented contexts
+    # where table width far exceeds typical kv_len. Kernel needs Mosaic DMA
+    # alignment: lane dim KVH*HD % 128, sublane BS % 8.
     aligned = (c.kv_size % 128 == 0) and (c.block_size % 8 == 0)
     on_tpu = jax.default_backend() == "tpu"
-    use_kernel = c.attention_impl == "paged_kernel" or (
-        c.attention_impl == "auto" and aligned and on_tpu and jax.device_count() == 1
-    )
+    use_kernel = c.attention_impl == "paged_kernel"
     if c.attention_impl == "paged_kernel" and on_tpu and not aligned:
         raise ValueError(
             f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "

@@ -128,6 +128,13 @@ class SchedulerConfig:
     # decode worker's pull before being reclaimed (orphan guard — e.g. the
     # decode worker timed out or died between prefill and pull).
     export_ttl_s: float = 120.0
+    # Multi-step decode: run N autoregressive steps + sampling on device per
+    # dispatch (vLLM --num-scheduler-steps role). Amortizes host dispatch —
+    # the dominant cost on high-latency links. Tradeoffs: tokens stream out
+    # in bursts of N, stop conditions trim after the window (up to N-1
+    # wasted steps per finished sequence), and admission waits for the
+    # window (only used when no request is waiting).
+    num_scheduler_steps: int = 1
 
 
 @dataclass
@@ -204,6 +211,15 @@ class Scheduler:
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)
+        self._supports_multi_step = hasattr(model, "decode_multi")
+        if self._supports_multi_step:
+            self._decode_multi_jit = jax.jit(
+                lambda p, k, v, t, pos, bt, act, te, tk, tp, key: model.decode_multi(
+                    p, self.mc, k, v, t, pos, bt, act, te, tk, tp, key,
+                    self.sc.num_scheduler_steps,
+                ),
+                donate_argnums=(1, 2),
+            )
 
     # --- public API (called from event loop) --------------------------------
     def add_request(
@@ -359,26 +375,39 @@ class Scheduler:
         self._append_token(seq, token, outputs)
         return True
 
+    def _width_bucket(self, max_used: int) -> int:
+        width = max(4, ((max_used + 15) // 16) * 16) if max_used > 4 else 4
+        return min(width, self.max_blocks_per_seq)
+
     def _decode_step(self) -> List[tuple]:
         outputs: List[tuple] = []
         n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
         batch = self.running[:n]
         bucket = next_bucket(n, self.sc.decode_buckets)
 
+        if (
+            self.sc.num_scheduler_steps > 1
+            and self._supports_multi_step
+            and not self.waiting  # don't delay admissions by a whole window
+            and not any(seq.sampling.logits_processors for seq in batch)
+            and self._decode_multi(batch, bucket, outputs)
+        ):
+            return outputs
+
         # Bucket the block-table width by the longest sequence in the batch:
         # the attention gather is O(table_width), so short contexts must not
-        # pay for max_seq_len (powers of two ⇒ bounded executable count).
-        max_used = max(len(seq.block_ids) for seq in batch)
-        width = 4
-        while width < max_used:
-            width *= 2
-        width = min(width, self.max_blocks_per_seq)
+        # pay for max_seq_len. 16-block (256-token) granularity keeps the
+        # gather within ~25% of the true context while bounding the
+        # executable count at max_seq_len/256 variants.
+        width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
 
         tokens = np.zeros((bucket,), dtype=np.int32)
         positions = np.zeros((bucket,), dtype=np.int32)
         tables = np.zeros((bucket, width), dtype=np.int32)
         active = np.zeros((bucket,), dtype=bool)
-        temps = np.ones((bucket,), dtype=np.float32)
+        # Pad rows are greedy (0.0) so all-greedy batches hit the sampler's
+        # argmax fast path regardless of bucket padding.
+        temps = np.zeros((bucket,), dtype=np.float32)
         top_ks = np.zeros((bucket,), dtype=np.int32)
         top_ps = np.ones((bucket,), dtype=np.float32)
 
@@ -423,6 +452,63 @@ class Scheduler:
             self._ensure_block_capacity(seq)
             self._append_token(seq, int(sampled[i]), outputs)
         return outputs
+
+    def _decode_multi(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
+        """Multi-step decode window: N steps in one dispatch, one host sync.
+        Returns False (caller falls back to single-step) when KV blocks for
+        the whole window can't be reserved."""
+        steps = self.sc.num_scheduler_steps
+        bs = self.mc.block_size
+        # Reserve blocks for the whole window up front (+1 for the next
+        # iteration's write slot, matching _ensure_block_capacity).
+        for seq in batch:
+            if seq.total_len + steps > self.mc.max_seq_len:
+                # Window would run past max_seq_len (and past the per-seq
+                # block-table capacity): let single-step finish it off.
+                return False
+            need = (seq.total_len + steps + bs - 1) // bs - len(seq.block_ids)
+            if need > 0:
+                try:
+                    seq.block_ids.extend(self.allocator.allocate(need))
+                except OutOfBlocksError:
+                    return False
+
+        width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
+
+        tokens = np.zeros((bucket,), dtype=np.int32)
+        positions = np.zeros((bucket,), dtype=np.int32)
+        tables = np.zeros((bucket, width), dtype=np.int32)
+        active = np.zeros((bucket,), dtype=bool)
+        # Pad rows are greedy (0.0) so all-greedy batches hit the sampler's
+        # argmax fast path regardless of bucket padding.
+        temps = np.zeros((bucket,), dtype=np.float32)
+        top_ks = np.zeros((bucket,), dtype=np.int32)
+        top_ps = np.ones((bucket,), dtype=np.float32)
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.all_ids[-1]
+            positions[i] = seq.total_len - 1
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            active[i] = True
+            temps[i] = seq.sampling.temperature
+            top_ks[i] = seq.sampling.top_k
+            top_ps[i] = seq.sampling.top_p
+
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng, self._step_counter)
+        toks_out, self.cache.k, self.cache.v = self._decode_multi_jit(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), key,
+        )
+        sampled = np.asarray(toks_out)  # [steps, bucket] — the one host sync
+
+        for i, seq in enumerate(batch):
+            for s in range(steps):
+                if seq.state != SeqState.RUNNING:
+                    break  # stopped mid-window; later tokens are trimmed
+                self._append_token(seq, int(sampled[s, i]), outputs)
+        return True
 
     # --- disaggregation support ---------------------------------------------
     def _inject_prefilled(self, seq: Sequence, outputs: List[tuple]) -> bool:

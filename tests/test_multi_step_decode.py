@@ -1,0 +1,121 @@
+"""Multi-step decode: N on-device autoregressive steps per dispatch must
+match single-step results exactly (greedy), and the scheduler must trim
+tokens past a stop condition mid-window."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.runtime.engine import Context
+
+
+def test_decode_multi_matches_single_greedy():
+    cfg = get_config("tiny").replace(num_layers=2)
+    B, steps = 4, 6
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    cache = KvCacheArrays.create(cfg, num_blocks=B * 6 + 2, dtype=jnp.float32)
+    max_blocks = 6
+    tables = jnp.array(1 + np.arange(B * max_blocks).reshape(B, max_blocks), dtype=jnp.int32)
+    toks0 = jnp.arange(B, dtype=jnp.int32) + 5
+    act = jnp.ones((B,), bool)
+    greedy = jnp.zeros((B,), jnp.float32)
+    top_k = jnp.zeros((B,), jnp.int32)
+    top_p = jnp.ones((B,), jnp.float32)
+
+    # Single-step reference rollout.
+    k, v = cache.k, cache.v
+    toks = toks0
+    ref = []
+    for s in range(steps):
+        poss = jnp.full((B,), s, jnp.int32)
+        logits, k, v = llama.decode(params, cfg, k, v, toks, poss, tables, act)
+        toks = jnp.argmax(logits, -1).astype(jnp.int32)
+        ref.append(np.asarray(toks))
+
+    out, k2, v2 = llama.decode_multi(
+        params, cfg, cache.k, cache.v, toks0, jnp.zeros((B,), jnp.int32),
+        tables, act, greedy, top_k, top_p, jax.random.PRNGKey(1), steps,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.stack(ref))
+    # KV caches identical too (skip scratch block 0).
+    np.testing.assert_allclose(np.asarray(k2[:, 1:]), np.asarray(k[:, 1:]), rtol=1e-5, atol=1e-5)
+
+
+def build_engine(steps: int, **kw):
+    return TpuEngine.build(
+        EngineArgs(
+            model="tiny",
+            dtype="float32",
+            seed=3,
+            eos_token_ids=[1],
+            scheduler=SchedulerConfig(
+                num_blocks=64,
+                prefill_buckets=[16, 32, 64],
+                decode_buckets=[1, 2, 4, 8],
+                num_scheduler_steps=steps,
+                **kw,
+            ),
+        )
+    )
+
+
+def req(tokens, max_tokens):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+
+
+async def collect(engine, request):
+    out, fin = [], None
+    async for frame in engine.generate(request, Context()):
+        data = frame.data if hasattr(frame, "data") else frame
+        if data:
+            out.extend(data.get("token_ids") or [])
+            fin = data.get("finish_reason") or fin
+    return out, fin
+
+
+async def test_engine_multi_step_matches_single_step():
+    # max_tokens=10 is NOT a multiple of the 4-step window: trimming matters.
+    single = build_engine(steps=1)
+    out1, fin1 = await collect(single, req(list(range(20, 36)), max_tokens=10))
+    await single.stop()
+
+    multi = build_engine(steps=4)
+    out4, fin4 = await collect(multi, req(list(range(20, 36)), max_tokens=10))
+    await multi.stop()
+
+    assert out4 == out1, f"multi-step {out4} != single-step {out1}"
+    assert len(out4) == 10 and fin4 == fin1 == "length"
+
+
+async def test_multi_step_near_max_seq_len_falls_back():
+    """A window that would run past max_seq_len (tiny: 256) must fall back
+    to single-step and finish with 'length' instead of crashing on the
+    clamped block table."""
+    eng = build_engine(steps=8)
+    prompt = list(range(2, 250))  # 248 tokens; limit hit mid-generation
+    out, fin = await collect(eng, req(prompt, max_tokens=64))
+    await eng.stop()
+    assert fin == "length"
+    assert 0 < len(out) <= 256 - 248
+
+
+async def test_engine_multi_step_concurrent_batch():
+    multi = build_engine(steps=4)
+    reqs = [req(list(range(10 + i, 26 + i)), max_tokens=9) for i in range(3)]
+    results = await asyncio.gather(*(collect(multi, r) for r in reqs))
+    await multi.stop()
+    for out, fin in results:
+        assert len(out) == 9 and fin == "length"
+    # Allocator fully drained after all sequences finish.
+    assert multi.scheduler.allocator.num_active == 0
