@@ -151,20 +151,27 @@ def prefill(
     total = cache_len + valid_len
     mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)
 
-    def layer_fn(h, xs):
-        lp, kc = xs  # kc [N, BS, 1, R]
+    # Cache as scan carry (see llama.decode_layer_scan): stacked ys would
+    # materialize a fresh full-cache copy per chunk/step.
+    def layer_fn(carry, xs):
+        h, kc = carry  # kc [L, N, BS, 1, R]
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_new = _latent_kv(x, lp, c, positions)  # [T, R]
-        kc = kc.at[tgt_blocks, tgt_offs, 0].set(latent_new)
-        latent_ctx = kc[block_table].reshape(ctx, latent_width(c))
+        kc = kc.at[l, tgt_blocks, tgt_offs, 0].set(latent_new)
+        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+        latent_ctx = kl[block_table].reshape(ctx, latent_width(c))
         attn = _attend_latent(q_eff, q_rope, latent_ctx, mask, lp, c)
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return h, kc
+        return (h, kc), None
 
-    h, k_new = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    (h, k_new), _ = lax.scan(
+        layer_fn, (h, k_cache),
+        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
+    )
     last = jnp.maximum(valid_len - 1, 0)
     h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -195,25 +202,66 @@ def decode(
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
     mask = key_pos[None, :] <= positions[:, None]
 
-    def layer_fn(h, xs):
-        lp, kc = xs
+    def layer_fn(carry, xs):
+        h, kc = carry
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         # dim 0 is the batch here; rope broadcasts per-row positions the same
         # way it broadcasts per-token positions in prefill.
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_row = _latent_kv(x, lp, c, positions)  # [B, R]
-        kc = kc.at[tgt_blocks, tgt_offs, 0].set(latent_row)
-        latent_ctx = kc[block_tables].reshape(B, ctx, R)
+        kc = kc.at[l, tgt_blocks, tgt_offs, 0].set(latent_row)
+        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+        latent_ctx = kl[block_tables].reshape(B, ctx, R)
         attn = jax.vmap(
             lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
         )(q_eff, q_rope, latent_ctx, mask)  # [B, H*v]
         h = h + attn @ lp["wo"]
         x2 = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x2, lp, c)
-        return h, kc
+        return (h, kc), None
 
-    h, k_new = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    (h, k_new), _ = lax.scan(
+        layer_fn, (h, k_cache),
+        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
+    )
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     logits = h @ (head if head is not None else params["embed"].T)
     return logits.astype(jnp.float32), k_new, v_cache
+
+
+def decode_multi(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, 1, R]
+    v_cache: jax.Array,  # unused
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    block_tables: jax.Array,  # [B, W] — must cover positions+num_steps
+    active: jax.Array,  # [B]
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+    rng_key: jax.Array,
+    num_steps: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-step decode window (see llama.decode_multi): N steps + sampling
+    per dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache)."""
+    from dynamo_tpu.engine.sampling import sample_batch
+
+    B = tokens.shape[0]
+
+    def body(i, state):
+        toks, poss, kc, out, key = state
+        logits, kc, _ = decode(params, config, kc, v_cache, toks, poss, block_tables, active)
+        key, sub = jax.random.split(key)
+        nxt = sample_batch(logits, temps, top_ks, top_ps, sub).astype(jnp.int32)
+        out = out.at[i].set(nxt)
+        return (nxt, poss + 1, kc, out, key)
+
+    out = jnp.zeros((num_steps, B), dtype=jnp.int32)
+    _, _, k_new, out, _ = lax.fori_loop(
+        0, num_steps, body, (tokens, positions, k_cache, out, rng_key)
+    )
+    return out, k_new, v_cache
