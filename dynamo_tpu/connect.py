@@ -166,12 +166,21 @@ class ReadableOperation(_Completable):
                     if not await call_home.connect():
                         continue
                     try:
-                        for i, d in enumerate(self.descriptors):
-                            await call_home.send(
-                                {"seq": i, "total": len(self.descriptors), **d.meta()},
-                                d.array.tobytes(),
-                            )
-                        await call_home.complete()
+                        try:
+                            for i, d in enumerate(self.descriptors):
+                                await call_home.send(
+                                    {"seq": i, "total": len(self.descriptors), **d.meta()},
+                                    d.array.tobytes(),
+                                )
+                            await call_home.complete()
+                        except Exception as e:
+                            # Tell the reader why before closing — otherwise it
+                            # hangs until its own wait_for_completion timeout.
+                            try:
+                                await call_home.error(f"serve failed: {e}")
+                            except (ConnectionError, OSError):
+                                pass
+                            raise
                     finally:
                         await call_home.close()
                     served += 1
@@ -221,6 +230,7 @@ class WritableOperation(_Completable):
         self._task = asyncio.get_running_loop().create_task(self._receive())
 
     async def _receive(self) -> None:
+        filled = set()
         try:
             async for frame in self._pending.frames():
                 if frame.kind == "data":
@@ -229,10 +239,17 @@ class WritableOperation(_Completable):
                         self._complete(f"bad descriptor index {seq}")
                         return
                     self.descriptors[seq]._fill(frame.body, frame.header)
+                    filled.add(seq)
                 elif frame.kind == "error":
                     self._complete(frame.header.get("message", "write failed"))
                     return
-            self._complete()
+            # Stream ended cleanly: only complete if every descriptor landed —
+            # a short write (peer stopped early, count mismatch) must surface,
+            # not yield silently stale buffers.
+            if len(filled) < len(self.descriptors):
+                self._complete(f"short write: {len(filled)}/{len(self.descriptors)} descriptors filled")
+            else:
+                self._complete()
         except (TransferError, ValueError, KeyError, TypeError) as e:
             # Malformed frame or unwritable destination: the op must still
             # complete (with the error) or waiters hang forever.
@@ -266,6 +283,7 @@ class ReadOperation(_Completable):
         )
 
         async def receive():
+            filled = set()
             try:
                 async for frame in pending.frames():
                     if frame.kind == "data":
@@ -274,10 +292,16 @@ class ReadOperation(_Completable):
                             self._complete(f"bad descriptor index {seq}")
                             return
                         self.descriptors[seq]._fill(frame.body, frame.header)
+                        filled.add(seq)
                     elif frame.kind == "error":
                         self._complete(frame.header.get("message", "read failed"))
                         return
-                self._complete()
+                # A serve that stopped early must fail the read, not succeed
+                # with stale/zero local buffers.
+                if len(filled) < len(self.descriptors):
+                    self._complete(f"short read: {len(filled)}/{len(self.descriptors)} descriptors filled")
+                else:
+                    self._complete()
             except (TransferError, ValueError, KeyError, TypeError) as e:
                 self._complete(str(e))
             finally:

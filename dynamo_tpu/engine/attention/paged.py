@@ -36,6 +36,7 @@ exercise the identical code path (``interpret=True``).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,11 +50,13 @@ NEG_INF = -1e30
 def _decode_kernel(
     # scalar prefetch
     tables_ref,  # SMEM [B, W] int32 — block ids per sequence
-    lens_ref,  # SMEM [B] int32 — kv length (positions + 1; 0 = inactive row)
+    lens_ref,  # SMEM [B] int32 — CACHED kv length (current token separate; 0 = inactive)
     # inputs
     w_ref,  # VMEM [1, KVH*HD, KVH*G] — block-diagonal queries
     k_hbm,  # ANY  [N, BS, KVH*HD]
     v_hbm,  # ANY  [N, BS, KVH*HD]
+    kcur_ref,  # VMEM [1, 1, KVH*HD] — current token's key (always attended)
+    vcur_ref,  # VMEM [1, 1, KVH*HD]
     # outputs
     out_ref,  # VMEM [1, KVH*G, KVH*HD]
     # scratch
@@ -64,6 +67,7 @@ def _decode_kernel(
     block_size: int,
     scale: float,
     strip: int,
+    fold_cur: bool,
 ):
     """Pages are processed in strips of ``strip`` pages per loop iteration:
     one 16-token page is a ~16 KB DMA (latency-bound) and a [rows, 16]
@@ -148,6 +152,26 @@ def _decode_kernel(
     acc0 = jnp.zeros((rows, merged), dtype=jnp.float32)
     m, l, acc = lax.fori_loop(0, n_strips, body, (m0, l0, acc0))
 
+    if fold_cur:
+        # Fold in the current token (its K/V never round-trips through HBM):
+        # one [rows] score + rank-1 accumulate closes the online softmax.
+        k_cur = kcur_ref[0]  # [1, merged]
+        v_cur = vcur_ref[0]
+        s_cur = lax.dot_general(
+            w, k_cur,
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rows, 1]
+        m_f = jnp.maximum(m, s_cur)
+        alpha_f = jnp.exp(m - m_f)
+        p_f = jnp.exp(s_cur - m_f)  # [rows, 1]
+        l = l * alpha_f + p_f
+        acc = acc * alpha_f + lax.dot_general(
+            p_f.astype(v_cur.dtype), v_cur,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
     l_safe = jnp.where(l > 0.0, l, 1.0)
     out_ref[0] = (acc / l_safe).astype(out_ref.dtype)
 
@@ -158,13 +182,21 @@ def paged_decode_attention(
     k_cache: jax.Array,  # [N, BS, KVH, HD]
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] int32
-    kv_lens: jax.Array,  # [B] int32 (0 for inactive rows)
+    kv_lens: jax.Array,  # [B] int32 — CACHED tokens per row (0 for inactive)
     *,
+    k_cur: Optional[jax.Array] = None,  # [B, KVH, HD] current token's K (attended in-register)
+    v_cur: Optional[jax.Array] = None,
     block_size: int,
     interpret: bool = False,
     pages_per_strip: int = 16,
 ) -> jax.Array:
-    """Single decode-step attention over the paged KV cache → [B, H, HD]."""
+    """Single decode-step attention over the paged KV cache → [B, H, HD].
+
+    ``k_cur``/``v_cur`` carry the token being decoded: it participates in
+    attention from registers (closing the online softmax) instead of being
+    read back from HBM, so callers can defer the cache write to one fused
+    all-layer scatter (llama.scatter_kv_rows). When omitted, rows attend to
+    the cached prefix only."""
     B, H, HD = q.shape
     N, BS, KVH, _ = k_cache.shape
     G = H // KVH
@@ -177,6 +209,17 @@ def paged_decode_attention(
     eye = jnp.eye(KVH, dtype=q.dtype)
     w = jnp.einsum("bkgd,kj->bkdjg", q5, eye).reshape(B, merged, rows)
 
+    if k_cur is None:
+        # No in-register token: fold a -inf-scoring dummy (zero K with the
+        # score masked via zero V and the guard below keeps exactness).
+        k_cur_m = jnp.zeros((B, 1, merged), dtype=k_cache.dtype)
+        v_cur_m = jnp.zeros((B, 1, merged), dtype=v_cache.dtype)
+        fold_cur = False
+    else:
+        k_cur_m = k_cur.reshape(B, 1, merged)
+        v_cur_m = v_cur.reshape(B, 1, merged)
+        fold_cur = True
+
     # Minor-dims merge is layout-free; pages DMA as contiguous [BS, KVH*HD].
     k_m = k_cache.reshape(N, BS, merged)
     v_m = v_cache.reshape(N, BS, merged)
@@ -188,6 +231,8 @@ def paged_decode_attention(
             pl.BlockSpec((1, merged, rows), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, rows, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -198,11 +243,13 @@ def paged_decode_attention(
     )
 
     out_m = pl.pallas_call(
-        functools.partial(_decode_kernel, block_size=block_size, scale=HD**-0.5, strip=strip),
+        functools.partial(
+            _decode_kernel, block_size=block_size, scale=HD**-0.5, strip=strip, fold_cur=fold_cur
+        ),
         out_shape=jax.ShapeDtypeStruct((B, rows, merged), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), w, k_m, v_m)
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), w, k_m, v_m, k_cur_m, v_cur_m)
 
     # Extract the block diagonal: out[b, kvh, g, :] = out_m[b, kvh*G+g, kvh*HD:+HD].
     out5 = out_m.reshape(B, KVH, G, KVH, HD)

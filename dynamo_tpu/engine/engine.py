@@ -55,6 +55,9 @@ class EngineArgs:
     kvbm_host_blocks: int = 0
     kvbm_disk_dir: Optional[str] = None
     kvbm_disk_blocks: int = 0
+    # Sharded serving: a ParallelConfig (engine/sharding.py) with total > 1
+    # builds a device mesh and shards params + KV cache over it.
+    parallel: Optional[Any] = None
 
 
 class TpuEngine:
@@ -93,6 +96,11 @@ class TpuEngine:
 
                 logger.warning("no checkpoint: initializing random weights for %s", mc.name)
                 params = get_module(mc).init_params(mc, jax.random.PRNGKey(args.seed), dtype=dtype)
+        mesh = None
+        if args.parallel is not None and args.parallel.total > 1:
+            from dynamo_tpu.engine.sharding import build_mesh
+
+            mesh = build_mesh(args.parallel)
         engine = cls(
             Scheduler(
                 mc,
@@ -102,6 +110,8 @@ class TpuEngine:
                 eos_token_ids=args.eos_token_ids,
                 on_kv_event=lambda ev: engine._on_kv_event(ev),
                 rng_seed=args.seed,
+                mesh=mesh,
+                parallel=args.parallel,
             ),
             kv_event_sink=kv_event_sink,
         )
@@ -242,6 +252,11 @@ class TpuEngine:
         """Pull a finished prefill-role request's KV blocks (device→host) and
         release them. Returns (blocks, hashes, prompt_len) or None."""
         return await asyncio.to_thread(self.scheduler.take_export, request_id)
+
+    async def take_export_device(self, request_id: str):
+        """Device-native export: stacked device arrays, no host round-trip.
+        Returns ((k_stack, v_stack), hashes, prompt_len) or None."""
+        return await asyncio.to_thread(self.scheduler.take_export_device, request_id)
 
     # --- introspection ------------------------------------------------------
     def metrics(self) -> ForwardPassMetrics:

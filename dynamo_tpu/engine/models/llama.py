@@ -180,17 +180,22 @@ def prefill(
     tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)  # [T]
     tgt_offs = slots % bs
 
-    # Context mask: key j attends iff j is written (< cache_len+valid) and
-    # causal wrt query position. Computed once, reused every layer.
+    # The cache is READ-ONLY inside the layer scan (slices ride the scan xs);
+    # each layer's fresh chunk K/V is attended in-register and stacked into
+    # the scan ys, then ONE fused scatter writes all layers afterwards. A
+    # scatter inside the carry forced XLA into a full cache copy per layer
+    # (~5 ms/step at 1B/b8 on v5e — measured); this formulation keeps the
+    # cache bytes touched proportional to the tokens written.
+    # Prefix mask: cached key j visible iff j < cache_len. Chunk-internal
+    # attention is causal within the chunk.
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    total = cache_len + valid_len
-    mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)  # [T, ctx]
+    prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))  # [T, ctx]
+    chunk_q = jnp.arange(T, dtype=jnp.int32)
+    chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]  # [T, T]
+    mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
 
-    # Cache as scan carry (see decode_layer_scan): avoids materializing a
-    # fresh full-cache pair per chunk.
-    def layer_fn(carry, xs):
-        h, kc, vc = carry
-        lp, l = xs
+    def layer_fn(h, xs):
+        lp, kl, vl = xs  # kl/vl: [N, BS, KVH, HD] — this layer's cache, read-only
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
@@ -198,24 +203,28 @@ def prefill(
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
-        kc = kc.at[l, tgt_blocks, tgt_offs].set(k)
-        vc = vc.at[l, tgt_blocks, tgt_offs].set(v)
-        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
-        vl = lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
-
         k_ctx = kl[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
         v_ctx = vl[block_table].reshape(ctx, c.num_kv_heads, c.head_dim)
-        attn = _attend(q, k_ctx, v_ctx, mask, c)
+        attn = _attend(
+            q,
+            jnp.concatenate([k_ctx, k], axis=0),
+            jnp.concatenate([v_ctx, v], axis=0),
+            mask,
+            c,
+        )
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return (h, kc, vc), None
+        return h, (k, v)
 
-    (h, k_new, v_new), _ = lax.scan(
-        layer_fn, (h, k_cache, v_cache),
-        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
-    )
+    h, (k_rows, v_rows) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
+
+    # One all-layer scatter: [L, T] targets into the donated cache buffers.
+    L = c.num_layers
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
+    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(k_rows)
+    v_new = v_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(v_rows)
 
     head = params.get("lm_head")
     if all_logits:
@@ -315,11 +324,14 @@ def decode_targets(
     active: jax.Array,  # [B] bool
     block_size: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Paged-KV scatter targets + causal context mask for one decode step.
+    """Paged-KV scatter targets + cached-prefix mask for one decode step.
 
     Inactive rows sink to scratch block 0 (never allocated). Returns
-    (tgt_blocks [B], tgt_offs [B], mask [B, ctx]). Shared by ``decode`` and
-    the pipelined path so the addressing convention lives in one place."""
+    (tgt_blocks [B], tgt_offs [B], mask [B, ctx]). The mask covers the
+    CACHED prefix only (key_pos < positions) — the current token's K/V is
+    folded into attention in-register, not read back from the cache. Shared
+    by ``decode`` and the pipelined path so the addressing convention lives
+    in one place."""
     slots = jnp.where(active, positions, 0)
     tgt_blocks = jnp.where(
         active, jnp.take_along_axis(block_tables, (slots // block_size)[:, None], axis=1)[:, 0], 0
@@ -327,7 +339,7 @@ def decode_targets(
     tgt_offs = slots % block_size
     ctx = block_tables.shape[1] * block_size
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    mask = key_pos[None, :] <= positions[:, None]  # [B, ctx]
+    mask = key_pos[None, :] < positions[:, None]  # [B, ctx] — cached prefix
     return tgt_blocks, tgt_offs, mask
 
 
@@ -338,66 +350,76 @@ def decode_layer_scan(
     v_cache: jax.Array,
     h: jax.Array,  # [B, D] embedded inputs (or activations from the previous pp stage)
     positions: jax.Array,  # [B]
-    tgt_blocks: jax.Array,  # [B] scatter block per row (0 = scratch sink)
-    tgt_offs: jax.Array,  # [B]
     block_tables: jax.Array,  # [B, max_blocks]
-    mask: jax.Array,  # [B, ctx] bool
-    kv_lens: Optional[jax.Array],  # [B] (kernel path only)
+    mask: jax.Array,  # [B, ctx] bool — cached prefix only (decode_targets)
+    kv_lens: Optional[jax.Array],  # [B] cached tokens per row (kernel path only)
     use_kernel: bool,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decode layer body over a stacked layer group. Factored out of
     ``decode`` so pipeline parallelism (pipeline_parallel.py) can run the
     same body on each stage's local L/pp slice of layers + KV cache.
 
-    The KV cache rides the scan CARRY (updated per layer with a dynamic
-    index), not the xs/ys stream: stacked ys would make XLA materialize a
-    fresh full-cache pair every step (~2× cache bytes of extra HBM traffic
-    per token — measured 13.3→8.4 ms/step on llama-3.2-1b, v5e), whereas a
-    carried buffer donates through in place."""
+    The cache is READ-ONLY here: per-layer slices ride the scan xs and each
+    layer's new K/V row is attended in-register (appended to the gathered
+    context / folded into the kernel's online softmax) and returned stacked
+    ``[L', B, KVH, HD]`` for the caller's single fused scatter. Writing the
+    cache inside the scan carry forced XLA into a full cache copy per layer
+    (~5 ms/step at 1B/b8 on v5e — measured with tools/profile_cache.py);
+    read-only xs slicing leaves the buffers untouched."""
     B = h.shape[0]
     bs = c.block_size
     ctx = block_tables.shape[1] * bs
-    L = k_cache.shape[0]
 
-    def layer_fn(carry, xs):
-        h, kc, vc = carry
-        lp, l = xs
+    def layer_fn(h, xs):
+        lp, kl, vl = xs  # kl/vl: [N, BS, KVH, HD] — this layer's cache, read-only
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
         v = (x @ lp["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions[:, None], c.rope_theta)[:, 0]  # [B, H, hd]
-        k = apply_rope(k, positions[:, None], c.rope_theta)[:, 0]
+        k = apply_rope(k, positions[:, None], c.rope_theta)[:, 0]  # [B, KVH, hd]
         v = v[:, 0]
-
-        kc = kc.at[l, tgt_blocks, tgt_offs].set(k)
-        vc = vc.at[l, tgt_blocks, tgt_offs].set(v)
-        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)  # [N, BS, KVH, HD]
-        vl = lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
 
         if use_kernel:
             from dynamo_tpu.engine.attention.paged import paged_decode_attention
 
             attn = paged_decode_attention(
-                q, kl, vl, block_tables, kv_lens,
+                q, kl, vl, block_tables, kv_lens, k_cur=k, v_cur=v,
                 block_size=bs, interpret=jax.default_backend() != "tpu",
             )  # [B, H, hd]
         else:
             k_ctx = kl[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
             v_ctx = vl[block_tables].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            k_full = jnp.concatenate([k_ctx, k[:, None]], axis=1)  # [B, ctx+1, KVH, hd]
+            v_full = jnp.concatenate([v_ctx, v[:, None]], axis=1)
+            mask_full = jnp.concatenate([mask, jnp.ones((B, 1), dtype=bool)], axis=1)
             attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
-                q, k_ctx, v_ctx, mask
+                q, k_full, v_full, mask_full
             )  # [B, H, hd]
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return (h, kc, vc), None
+        return h, (k, v)
 
-    (h, k_new, v_new), _ = lax.scan(
-        layer_fn, (h, k_cache, v_cache), (layers, jnp.arange(L, dtype=jnp.int32))
-    )
-    return h, k_new, v_new
+    h, (k_rows, v_rows) = lax.scan(layer_fn, h, (layers, k_cache, v_cache))
+    return h, k_rows, v_rows
+
+
+def scatter_kv_rows(
+    k_cache: jax.Array,  # [L', N, BS, KVH, HD]
+    v_cache: jax.Array,
+    k_rows: jax.Array,  # [L', B, KVH, HD] from decode_layer_scan
+    v_rows: jax.Array,
+    tgt_blocks: jax.Array,  # [B]
+    tgt_offs: jax.Array,  # [B]
+) -> Tuple[jax.Array, jax.Array]:
+    """Single fused all-layer KV write (one scatter per cache tensor)."""
+    L, B = k_rows.shape[0], k_rows.shape[1]
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
+    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(k_rows)
+    v_new = v_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(v_rows)
+    return k_new, v_new
 
 
 def decode(
@@ -434,12 +456,14 @@ def decode(
             f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
             f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
         )
-    kv_lens = jnp.where(active, positions + 1, 0)
+    # Cached tokens per row (current token folded in-register, not read back).
+    kv_lens = jnp.where(active, positions, 0)
 
-    h, k_new, v_new = decode_layer_scan(
+    h, k_rows, v_rows = decode_layer_scan(
         params["layers"], c, k_cache, v_cache, h, positions,
-        tgt_blocks, tgt_offs, block_tables, mask, kv_lens, use_kernel,
+        block_tables, mask, kv_lens, use_kernel,
     )
+    k_new, v_new = scatter_kv_rows(k_cache, v_cache, k_rows, v_rows, tgt_blocks, tgt_offs)
 
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")

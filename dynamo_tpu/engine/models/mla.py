@@ -147,31 +147,34 @@ def prefill(
     tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)
     tgt_offs = slots % bs
 
+    # Cache read-only in the scan (slices ride xs); the chunk's latent rows
+    # come out as ys and ONE fused scatter writes all layers afterwards — a
+    # scatter inside the carry forces a full cache copy per layer (measured;
+    # see llama.decode_layer_scan).
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    total = cache_len + valid_len
-    mask = (key_pos[None, :] <= positions[:, None]) & (key_pos[None, :] < total)
+    prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))
+    chunk_q = jnp.arange(T, dtype=jnp.int32)
+    chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]
+    mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
 
-    # Cache as scan carry (see llama.decode_layer_scan): stacked ys would
-    # materialize a fresh full-cache copy per chunk/step.
-    def layer_fn(carry, xs):
-        h, kc = carry  # kc [L, N, BS, 1, R]
-        lp, l = xs
+    def layer_fn(h, xs):
+        lp, kl = xs  # kl [N, BS, 1, R] — this layer's latent cache, read-only
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_new = _latent_kv(x, lp, c, positions)  # [T, R]
-        kc = kc.at[l, tgt_blocks, tgt_offs, 0].set(latent_new)
-        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
         latent_ctx = kl[block_table].reshape(ctx, latent_width(c))
-        attn = _attend_latent(q_eff, q_rope, latent_ctx, mask, lp, c)
+        attn = _attend_latent(
+            q_eff, q_rope, jnp.concatenate([latent_ctx, latent_new], axis=0), mask, lp, c
+        )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x, lp, c)
-        return (h, kc), None
+        return h, latent_new
 
-    (h, k_new), _ = lax.scan(
-        layer_fn, (h, k_cache),
-        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
-    )
+    h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    L = c.num_layers
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
+    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
     last = jnp.maximum(valid_len - 1, 0)
     h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -199,32 +202,33 @@ def decode(
     slots = jnp.where(active, positions, 0)
     tgt_blocks = jnp.where(active, jnp.take_along_axis(block_tables, (slots // bs)[:, None], axis=1)[:, 0], 0)
     tgt_offs = slots % bs
+    # Cached-prefix mask; the current row's latent is attended in-register
+    # and written back with one fused scatter after the scan.
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    mask = key_pos[None, :] <= positions[:, None]
+    mask = key_pos[None, :] < positions[:, None]
+    mask_full = jnp.concatenate([mask, jnp.ones((B, 1), dtype=bool)], axis=1)
 
-    def layer_fn(carry, xs):
-        h, kc = carry
-        lp, l = xs
+    def layer_fn(h, xs):
+        lp, kl = xs  # kl [N, BS, 1, R] — read-only
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         # dim 0 is the batch here; rope broadcasts per-row positions the same
         # way it broadcasts per-token positions in prefill.
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_row = _latent_kv(x, lp, c, positions)  # [B, R]
-        kc = kc.at[l, tgt_blocks, tgt_offs, 0].set(latent_row)
-        kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
         latent_ctx = kl[block_tables].reshape(B, ctx, R)
+        latent_full = jnp.concatenate([latent_ctx, latent_row[:, None]], axis=1)
         attn = jax.vmap(
             lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
-        )(q_eff, q_rope, latent_ctx, mask)  # [B, H*v]
+        )(q_eff, q_rope, latent_full, mask_full)  # [B, H*v]
         h = h + attn @ lp["wo"]
         x2 = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         h = h + _mlp(x2, lp, c)
-        return (h, kc), None
+        return h, latent_row
 
-    (h, k_new), _ = lax.scan(
-        layer_fn, (h, k_cache),
-        (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32)),
-    )
+    h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    L = c.num_layers
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
+    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     logits = h @ (head if head is not None else params["embed"].T)

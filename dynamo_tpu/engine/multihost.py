@@ -1,0 +1,171 @@
+"""Multi-host engine coordination: jax.distributed over ICI + DCN.
+
+Ref: the reference coordinates multi-node engines via ``MultiNodeConfig``
+(lib/llm/src/engines.rs:28 — node_rank/num_nodes/leader) and MPI/srun
+launchers (components/backends/trtllm/multinode/srun_*.sh). The TPU-native
+equivalent is JAX's multi-controller runtime: every host process calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)``;
+afterwards ``jax.devices()`` spans all hosts and the exact same
+Mesh/pjit/shard_map serving code runs SPMD across the pod — XLA routes
+collectives over ICI within a slice and DCN across slices.
+
+Topology-aware meshes: ``build_multihost_mesh`` places the DCN-crossing
+axis (data parallel between slices) outermost via
+``mesh_utils.create_hybrid_device_mesh`` so only dp-gradient-free
+serving traffic (none) or batch splits ride DCN, while tp/ep/sp/pp stay
+on ICI.
+
+Rendezvous without static addresses: the leader (first process to win the
+create-only store key) publishes its coordinator address; followers pick up
+the address and claim dense process ids from an atomic counter — the
+etcd-barrier pattern the reference uses for its KVBM leader
+(lib/llm/src/block_manager/distributed/leader.rs:24).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+COORD_PREFIX = "multihost"
+
+
+@dataclass
+class MultiHostConfig:
+    """Ref: engines.rs:28 MultiNodeConfig{num_nodes, node_rank, leader}."""
+
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator: Optional[str] = None  # host:port of process 0
+
+    @classmethod
+    def from_env(cls) -> "MultiHostConfig":
+        return cls(
+            num_processes=int(os.environ.get("DYN_MULTIHOST_PROCESSES", "1")),
+            process_id=int(os.environ.get("DYN_MULTIHOST_PROCESS_ID", "0")),
+            coordinator=os.environ.get("DYN_MULTIHOST_COORDINATOR") or None,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+
+def init_multihost(cfg: MultiHostConfig) -> None:
+    """Join the multi-controller runtime. Must run before any jax backend
+    touch; afterwards jax.devices() is global, jax.local_devices() is ours."""
+    if not cfg.enabled:
+        return
+    import jax
+
+    if cfg.coordinator is None:
+        raise ValueError("multi-host needs a coordinator address (leader's host:port)")
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    logger.info(
+        "multihost up: process %d/%d, %d local / %d global devices",
+        cfg.process_id, cfg.num_processes, jax.local_device_count(), jax.device_count(),
+    )
+
+
+def pick_coordinator_port(host: Optional[str] = None) -> str:
+    """Reserve an ephemeral port on this host for the coordinator service."""
+    host = host or socket.gethostname()
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return f"{host}:{s.getsockname()[1]}"
+
+
+async def rendezvous(drt, group: str, num_processes: int, *, timeout_s: float = 60.0) -> MultiHostConfig:
+    """Store-based coordinator election + dense process-id assignment.
+
+    The first process to create ``multihost/{group}/coordinator`` becomes
+    process 0 and publishes its address; every process (leader included)
+    claims a unique id by create-only puts on ``multihost/{group}/rank/{i}``.
+    """
+    import asyncio
+    import time
+
+    from dynamo_tpu.runtime.transports.kvstore import KeyExists
+
+    coord_key = f"{COORD_PREFIX}/{group}/coordinator"
+    addr = pick_coordinator_port()
+    try:
+        await drt.store.put(coord_key, addr.encode(), create_only=True)
+        coordinator = addr
+    except KeyExists:
+        entry = await drt.store.get(coord_key)
+        coordinator = entry.value.decode()
+
+    process_id = None
+    deadline = time.monotonic() + timeout_s
+    while process_id is None:
+        for i in range(num_processes):
+            try:
+                await drt.store.put(f"{COORD_PREFIX}/{group}/rank/{i}", addr.encode(), create_only=True)
+                process_id = i
+                break
+            except KeyExists:
+                continue
+        if process_id is None:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no free rank among {num_processes} for group {group}")
+            await asyncio.sleep(0.1)
+
+    # The coordinator address must belong to rank 0: if we won rank 0 but a
+    # different process won the coordinator key (race), re-point it at us.
+    if process_id == 0 and coordinator != addr:
+        await drt.store.put(coord_key, addr.encode())
+        coordinator = addr
+
+    return MultiHostConfig(num_processes=num_processes, process_id=process_id, coordinator=coordinator)
+
+
+def build_multihost_mesh(parallel, dcn_dp: int = 1):
+    """Mesh over all hosts' devices: DCN-crossing dp axis outermost, ICI
+    axes (pp/sp/ep/tp + intra-slice dp) inner.
+
+    ``parallel`` is the per-slice ParallelConfig (engine/sharding.py);
+    ``dcn_dp`` is the number of slices (data-parallel replicas across DCN).
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    total_ici = parallel.total
+    n = total_ici * dcn_dp
+    if jax.device_count() < n:
+        raise ValueError(f"need {n} devices, have {jax.device_count()}")
+    if dcn_dp == 1:
+        from dynamo_tpu.engine.sharding import build_mesh
+
+        return build_mesh(parallel)
+    try:
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(parallel.dp, parallel.pp, parallel.sp, parallel.ep, parallel.tp),
+            dcn_mesh_shape=(dcn_dp, 1, 1, 1, 1),
+            devices=jax.devices()[:n],
+        )
+        arr = np.asarray(devices)
+    except ValueError:
+        # Non-TPU devices carry no slice_index topology: fall back to
+        # process-ordered placement (jax.devices() is ordered by process, and
+        # process boundaries ARE the DCN boundaries).
+        arr = np.array(jax.devices()[:n])
+    # Hybrid mesh folds dcn_dp into the first axis: [dcn_dp*dp, pp, sp, ep, tp].
+    arr = arr.reshape(dcn_dp * parallel.dp, parallel.pp, parallel.sp, parallel.ep, parallel.tp)
+    return Mesh(arr, axis_names=("dp", "pp", "sp", "ep", "tp"))

@@ -32,7 +32,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
-from dynamo_tpu.engine.models.llama import decode_layer_scan, decode_targets, rms_norm
+from dynamo_tpu.engine.models.llama import (
+    decode_layer_scan,
+    decode_targets,
+    rms_norm,
+    scatter_kv_rows,
+)
 
 
 def pipelined_decode(
@@ -103,10 +108,11 @@ def pipelined_decode(
 
             tgt_blocks, tgt_offs, mask = decode_targets(poss_i, tables_i, act_i, bs)
 
-            h_out, kc, vc = decode_layer_scan(
+            h_out, k_rows, v_rows = decode_layer_scan(
                 layers, c, kc, vc, h_in, poss_i,
-                tgt_blocks, tgt_offs, tables_i, mask, None, use_kernel=False,
+                tables_i, mask, None, use_kernel=False,
             )
+            kc, vc = scatter_kv_rows(kc, vc, k_rows, v_rows, tgt_blocks, tgt_offs)
 
             # Only the last stage's output is real; collect hidden states
             # ([mb, D], cheap) — the lm-head matmul runs once after the loop,

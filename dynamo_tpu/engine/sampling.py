@@ -33,10 +33,28 @@ class SamplingParams:
 # Top-k/top-p thresholds are resolved inside the best-SAMPLE_WINDOW logits
 # (lax.top_k) instead of a full-vocab sort: two O(V log V) sorts per step
 # cost ~7 ms on a 128k vocab (v5e, b8) — more than the whole 1B forward
-# pass. Effective top_k clamps to the window; top_p falls back to plain
-# categorical in the (pathological) case where the window holds less than
-# ``top_p`` probability mass.
+# pass. The windowed result is checked for exactness per row: when any row
+# requests top_k > SAMPLE_WINDOW, or its window holds less than ``top_p``
+# probability mass, the batch falls back to the exact full-vocab sort for
+# that step (runtime lax.cond — the fast path stays sort-free). Sampling
+# semantics therefore always match the requested top-k/top-p exactly.
 SAMPLE_WINDOW = 64
+
+
+def _exact_thresholds(scaled, lse, top_k, top_p):
+    """Full-vocab top-k/top-p truncation thresholds (one descending sort)."""
+    V = scaled.shape[-1]
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(srt, k_idx[:, None], axis=1)[:, 0]
+    k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)
+
+    probs = jnp.exp(srt - lse)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    min_kept = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)
+    p_thresh = jnp.where(top_p < 1.0, min_kept, -jnp.inf)
+    return jnp.maximum(k_thresh, p_thresh)
 
 
 def sample_batch(
@@ -57,23 +75,31 @@ def sample_batch(
         scaled = logits / safe_temp[:, None]
         cap = min(SAMPLE_WINDOW, V)
         top_vals = jax.lax.top_k(scaled, cap)[0]  # [B, cap] descending
-
-        # top-k threshold: the k-th largest (k clamped to the window).
-        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, cap) - 1, 0, cap - 1)
-        kth = jnp.take_along_axis(top_vals, k_idx[:, None], axis=1)[:, 0]
-        k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)
-
-        # top-p threshold: smallest prob among the nucleus, within the window.
         lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
         probs_top = jnp.exp(top_vals - lse)  # true probabilities of window
         cum = jnp.cumsum(probs_top, axis=-1)
-        keep = (cum - probs_top) < top_p[:, None]  # keep while prior mass < p
-        min_kept = jnp.min(jnp.where(keep, top_vals, jnp.inf), axis=-1)
-        # Window exhausted before reaching mass p ⇒ no truncation.
-        min_kept = jnp.where(cum[:, -1] < top_p, -jnp.inf, min_kept)
-        p_thresh = jnp.where(top_p < 1.0, min_kept, -jnp.inf)
 
-        thresh = jnp.maximum(k_thresh, p_thresh)
+        def windowed(_):
+            # top-k threshold: the k-th largest (k ≤ window by construction).
+            k_idx = jnp.clip(jnp.where(top_k > 0, top_k, cap) - 1, 0, cap - 1)
+            kth = jnp.take_along_axis(top_vals, k_idx[:, None], axis=1)[:, 0]
+            k_thresh = jnp.where(top_k > 0, kth, -jnp.inf)
+            # top-p threshold: smallest prob among the nucleus.
+            keep = (cum - probs_top) < top_p[:, None]  # keep while prior mass < p
+            min_kept = jnp.min(jnp.where(keep, top_vals, jnp.inf), axis=-1)
+            p_thresh = jnp.where(top_p < 1.0, min_kept, -jnp.inf)
+            return jnp.maximum(k_thresh, p_thresh)
+
+        # Window is exact for a row iff requested k fits and (top_p off or
+        # the window holds ≥ top_p of the probability mass).
+        sampling_row = temperature > 0
+        k_fits = (top_k <= 0) | (top_k <= cap)
+        p_fits = (top_p >= 1.0) | (cum[:, -1] >= top_p)
+        window_exact = jnp.all(~sampling_row | (k_fits & p_fits)) | (cap == V)
+
+        thresh = jax.lax.cond(
+            window_exact, windowed, lambda _: _exact_thresholds(scaled, lse, top_k, top_p), None
+        )
         masked = jnp.where(scaled >= thresh[:, None], scaled, -jnp.inf)
         sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
         return jnp.where(temperature > 0, sampled, greedy_tok)

@@ -106,6 +106,11 @@ class Sequence:
     keep_blocks_on_finish: bool = False
     # Decode-role sequences start from remotely prefilled KV.
     prefilled: Optional[dict] = None
+    # Preemption resume: tokens whose KV must be recomputed (all generated
+    # tokens fold in; the final token re-enters via decode, so no sampling
+    # happens at the end of a resume prefill).
+    resume_tokens: Optional[List[int]] = None
+    preemptions: int = 0
 
     @property
     def all_ids(self) -> List[int]:
@@ -135,6 +140,17 @@ class SchedulerConfig:
     # wasted steps per finished sequence), and admission waits for the
     # window (only used when no request is waiting).
     num_scheduler_steps: int = 1
+    # ITL protection: while sequences are decoding, cap each prefill chunk so
+    # its estimated device time stays under this budget (the prefill token
+    # rate is learned online from measured chunks). None ⇒ chunks use
+    # max_prefill_chunk regardless of running decodes. This bounds the
+    # decode stall a long prompt can inject — the role chunked-prefill
+    # interleaving plays in the reference's engines (mocker/scheduler.rs:240).
+    itl_budget_ms: Optional[float] = None
+    # On OutOfBlocks mid-decode, preempt the newest running sequence (free
+    # its blocks, re-prefill it later) instead of finishing the starved
+    # sequence with "length" (ref: vLLM recompute preemption).
+    enable_preemption: bool = True
 
 
 @dataclass
@@ -171,14 +187,31 @@ class Scheduler:
         on_kv_event: Optional[Callable[[KvEvent], None]] = None,
         eos_token_ids: Optional[List[int]] = None,
         rng_seed: int = 0,
+        mesh=None,
+        parallel=None,
     ):
         self.mc = model_config
         self.sc = scheduler_config or SchedulerConfig()
-        self.params = params
+        self.mesh = mesh
+        self.parallel = parallel
         self.allocator = BlockAllocator(self.sc.num_blocks, on_event=on_kv_event)
         # Reserve block 0 as the scratch sink for padded scatter positions.
         self.allocator._free.remove(0)
-        self.cache = KvCacheArrays.create(model_config, self.sc.num_blocks, dtype=dtype)
+        if mesh is not None:
+            # Sharded serving: place params + cache with the real partition
+            # specs; GSPMD propagates shardings through the jitted steps and
+            # inserts the tp all-reduces / dp batch splits over ICI.
+            from jax.sharding import NamedSharding
+
+            from dynamo_tpu.engine.sharding import kv_cache_spec, shard_params
+
+            tp = parallel.tp if parallel is not None else mesh.shape.get("tp", 1)
+            params = shard_params(params, mesh, model_config.tie_word_embeddings, model_config.num_experts)
+            cache_sharding = NamedSharding(mesh, kv_cache_spec(model_config.num_kv_heads, tp))
+            self.cache = KvCacheArrays.create(model_config, self.sc.num_blocks, dtype=dtype, sharding=cache_sharding)
+        else:
+            self.cache = KvCacheArrays.create(model_config, self.sc.num_blocks, dtype=dtype)
+        self.params = params
         self.max_blocks_per_seq = (model_config.max_seq_len + model_config.block_size - 1) // model_config.block_size
 
         # Optional tiered block manager (KVBM) — set via attach_kvbm().
@@ -190,6 +223,9 @@ class Scheduler:
         self.running: List[Sequence] = []
         self.by_id: Dict[str, Sequence] = {}
         self.request_total = 0
+        self.preempt_total = 0
+        # Online prefill-rate estimate (tokens/s) for ITL-budgeted chunking.
+        self._prefill_tok_s: Optional[float] = None
         self._eos = eos_token_ids or []
         self._rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
@@ -312,26 +348,32 @@ class Scheduler:
 
     def _prefill_one(self, seq: Sequence, outputs: List[tuple]) -> bool:
         """Run one prefill chunk for ``seq``. Returns True when the prompt is
-        fully computed (sequence moved to running)."""
+        fully computed (sequence moved to running). Preempted sequences
+        resume here: ``resume_tokens`` (prompt + generated so far, minus the
+        last token) recompute their KV, then decode continues — no sampling
+        at the end of a resume."""
         bs = self.mc.block_size
         if seq.state == SeqState.WAITING and seq.prefilled is not None:
             return self._inject_prefilled(seq, outputs)
+        resuming = seq.resume_tokens is not None
+        pf_tokens = seq.resume_tokens if resuming else seq.prompt
         if seq.state == SeqState.WAITING:
             # First touch: prefix-cache match + full block allocation. Must be
             # all-or-nothing: a partial failure here re-runs next step, so any
             # acquired refs/blocks must be returned before backing off.
             try:
                 if self.sc.enable_prefix_caching:
-                    seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
+                    seq.block_hashes = extend_block_hashes([], pf_tokens, bs)
                     matched = self._match_prefix_tiers(seq)
                     # Keep at least one token to prefill so we always produce logits.
-                    if matched and len(matched) * bs >= len(seq.prompt):
+                    if matched and len(matched) * bs >= len(pf_tokens):
                         self.allocator.release([matched[-1]])
                         matched = matched[:-1]
                     seq.block_ids = list(matched)
                     seq.num_cached_blocks = len(matched)
                     seq.num_computed = len(matched) * bs
-                needed = (len(seq.prompt) + 1 + bs - 1) // bs - len(seq.block_ids)  # +1 for first decode token
+                total_tokens = (seq.total_len if resuming else len(seq.prompt)) + 1
+                needed = (total_tokens + bs - 1) // bs - len(seq.block_ids)
                 if needed > 0:
                     seq.block_ids.extend(self.allocator.allocate(needed))
             except OutOfBlocksError:
@@ -342,16 +384,17 @@ class Scheduler:
                 raise
             seq.state = SeqState.PREFILL
 
-        remaining = len(seq.prompt) - seq.num_computed
-        chunk = min(remaining, self.sc.max_prefill_chunk)
+        remaining = len(pf_tokens) - seq.num_computed
+        chunk = min(remaining, self._chunk_budget())
         bucket = next_bucket(chunk, self.sc.prefill_buckets)
         chunk = min(chunk, bucket)
 
-        tokens = seq.prompt[seq.num_computed : seq.num_computed + chunk]
+        tokens = pf_tokens[seq.num_computed : seq.num_computed + chunk]
         padded = np.zeros((bucket,), dtype=np.int32)
         padded[: len(tokens)] = tokens
         table = self._block_table(seq)
 
+        t0 = time.monotonic() if self.sc.itl_budget_ms else None
         logits, self.cache.k, self.cache.v = self._prefill_jit(
             self.params,
             self.cache.k,
@@ -361,10 +404,27 @@ class Scheduler:
             jnp.int32(seq.num_computed),
             table,
         )
+        if t0 is not None:
+            # Sync to learn the chunk rate (feeds _chunk_budget's EMA).
+            logits.block_until_ready()
+            dt = max(time.monotonic() - t0, 1e-6)
+            rate = len(tokens) / dt
+            self._prefill_tok_s = rate if self._prefill_tok_s is None else (
+                0.7 * self._prefill_tok_s + 0.3 * rate
+            )
         seq.num_computed += len(tokens)
 
-        if seq.num_computed < len(seq.prompt):
+        if seq.num_computed < len(pf_tokens):
             return False  # more chunks to go
+
+        if resuming:
+            # KV restored through the last generated token; the final token
+            # re-enters via the decode step — nothing to sample or emit.
+            seq.resume_tokens = None
+            seq.state = SeqState.RUNNING
+            self.running.append(seq)
+            self._register_full_blocks(seq)
+            return True
 
         # Prompt fully computed: sample the first token.
         token = self._sample_one(seq, logits)
@@ -374,6 +434,17 @@ class Scheduler:
         self._register_full_blocks(seq)
         self._append_token(seq, token, outputs)
         return True
+
+    def _chunk_budget(self) -> int:
+        """Max prefill-chunk tokens for this iteration. With an ITL budget
+        and live decodes, cap the chunk so its estimated device time stays
+        within budget (never below the smallest bucket — progress must be
+        made)."""
+        cap = self.sc.max_prefill_chunk
+        if not self.sc.itl_budget_ms or not self.running or self._prefill_tok_s is None:
+            return cap
+        budget_tokens = int(self.sc.itl_budget_ms / 1000.0 * self._prefill_tok_s)
+        return max(min(cap, budget_tokens), self.sc.prefill_buckets[0])
 
     def _width_bucket(self, max_used: int) -> int:
         width = max(4, ((max_used + 15) // 16) * 16) if max_used > 4 else 4
@@ -449,7 +520,11 @@ class Scheduler:
         )
 
         for i, seq in enumerate(batch):
+            if seq.state != SeqState.RUNNING:
+                continue  # preempted while growing an earlier row this step
             self._ensure_block_capacity(seq)
+            if seq.state != SeqState.RUNNING:
+                continue  # itself preempted (no candidate to evict)
             self._append_token(seq, int(sampled[i]), outputs)
         return outputs
 
@@ -513,15 +588,22 @@ class Scheduler:
     # --- disaggregation support ---------------------------------------------
     def _inject_prefilled(self, seq: Sequence, outputs: List[tuple]) -> bool:
         """Decode-role admission: KV arrived from a prefill worker — scatter
-        it into fresh blocks and enter decode directly (no prefill compute)."""
-        from dynamo_tpu.llm.block_manager.transfer import scatter_blocks
+        it into fresh blocks and enter decode directly (no prefill compute).
+        ``prefilled["blocks"]`` carries host numpy block pairs (wire path);
+        ``prefilled["device_blocks"]`` carries stacked device arrays (the
+        device-native path: in-process handoff or transfer-server pull)."""
+        from dynamo_tpu.llm.block_manager.transfer import scatter_blocks, scatter_blocks_device
 
         bs = self.mc.block_size
         data = seq.prefilled
         n_blocks = (len(seq.prompt) + 1 + bs - 1) // bs
         seq.block_ids = self.allocator.allocate(n_blocks)  # raises → retried next step
-        for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
-            scatter_blocks(self.cache, bid, k_np, v_np)
+        if "device_blocks" in data:
+            k_stack, v_stack = data["device_blocks"]
+            scatter_blocks_device(self.cache, seq.block_ids[: k_stack.shape[1]], k_stack, v_stack)
+        else:
+            for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
+                scatter_blocks(self.cache, bid, k_np, v_np)
         seq.num_computed = len(seq.prompt)
         if self.sc.enable_prefix_caching:
             seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
@@ -546,6 +628,23 @@ class Scheduler:
         self.allocator.release(seq.block_ids)
         seq.block_ids = []
         return data, seq.block_hashes, len(seq.prompt)
+
+    def take_export_device(self, request_id: str):
+        """Device-native export: stack the sequence's blocks into fresh
+        device arrays (one fused gather, no host round-trip) and release
+        them. Returns ((k_stack [L,n,BS,KVH,HD], v_stack|None), hashes,
+        prompt_len) or None. The stack is independent of the cache, so it
+        can await a remote pull while the blocks are reused."""
+        from dynamo_tpu.llm.block_manager.transfer import gather_blocks_device
+
+        seq = self._pending_exports.pop(request_id, None)
+        self._export_deadline.pop(request_id, None)
+        if seq is None:
+            return None
+        k_stack, v_stack = gather_blocks_device(self.cache, seq.block_ids)
+        self.allocator.release(seq.block_ids)
+        seq.block_ids = []
+        return (k_stack, v_stack), seq.block_hashes, len(seq.prompt)
 
     def expire_exports(self, now: Optional[float] = None) -> int:
         """Reclaim exports nobody pulled within export_ttl_s. Returns count."""
@@ -577,16 +676,47 @@ class Scheduler:
         return jnp.asarray(table)
 
     def _ensure_block_capacity(self, seq: Sequence) -> None:
-        """Grow the block table if the *next* token would overflow it."""
+        """Grow the block table if the *next* token would overflow it.
+        On OutOfBlocks, preempt the newest other running sequence (recompute
+        preemption) and retry; only when no victim exists does the sequence
+        finish with "length"."""
         bs = self.mc.block_size
-        if seq.total_len + 1 > len(seq.block_ids) * bs:
+        while seq.total_len + 1 > len(seq.block_ids) * bs:
             try:
                 seq.block_ids.extend(self.allocator.allocate(1))
+                return
             except OutOfBlocksError:
-                # Out of memory mid-decode: finish the sequence with "length".
+                if self.sc.enable_preemption and self._preempt_for(seq):
+                    continue  # victim freed blocks — retry
+                # Out of memory, nobody to evict: finish with "length".
                 seq.aborted = True
                 seq.abort_reason = "length"
                 logger.warning("seq %s out of KV blocks at len %d", seq.request_id, seq.total_len)
+                return
+
+    def _preempt_for(self, needy: Sequence) -> bool:
+        """Evict the newest other running sequence: release its blocks and
+        send it back to the waiting queue for recompute (ref: vLLM recompute
+        preemption). Returns True if a victim was preempted."""
+        candidates = [s for s in self.running if s is not needy and s.state == SeqState.RUNNING]
+        if not candidates:
+            return False
+        victim = max(candidates, key=lambda s: s.arrival_ts)
+        self.running.remove(victim)
+        self.allocator.release(victim.block_ids)
+        victim.block_ids = []
+        victim.block_hashes = []
+        victim.num_cached_blocks = 0
+        victim.num_computed = 0
+        # Recompute everything up to (not including) the last token; the
+        # last token re-enters through the decode step on resume.
+        victim.resume_tokens = list(victim.all_ids[:-1])
+        victim.state = SeqState.WAITING
+        victim.preemptions += 1
+        self.preempt_total += 1
+        self.waiting.insert(0, victim)
+        logger.info("preempted %s (len %d) to free blocks", victim.request_id, victim.total_len)
+        return True
 
     def _sample_one(self, seq: Sequence, logits: jax.Array) -> int:
         self._step_counter += 1

@@ -1,0 +1,110 @@
+"""Device-to-device KV transfer plane — the NIXL replacement.
+
+Ref: the reference moves KV blocks GPU→GPU with NIXL one-sided RDMA
+(lib/bindings/python src/dynamo/nixl_connect/__init__.py:501-1417; vllm
+handlers.py:153-204). The TPU equivalent rides
+``jax.experimental.transfer`` — XLA's cross-process transfer server, which
+moves device buffers peer-to-peer over the fastest available fabric (ICI
+within a slice, DCN/TCP across hosts) in a one-sided *pull* model exactly
+like NIXL:
+
+- producer: ``offer(uuid, arrays)`` schedules device buffers for pickup;
+- consumer: ``pull(address, uuid, specs)`` lands them on its own devices;
+- rendezvous metadata (address/uuid/shape/dtype — the ``RdmaMetadata``
+  role) travels out-of-band on the control plane.
+
+The same class serves the in-process case via
+``transfer.copy_blocks_between`` (no server round-trip at all).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _uuid_of(request_id: str) -> int:
+    import hashlib
+
+    return int.from_bytes(hashlib.blake2s(request_id.encode(), digest_size=8).digest(), "big") >> 1
+
+
+class DeviceTransferPlane:
+    """One per process. Lazily starts the transfer server on first use."""
+
+    def __init__(self, transport_ip: str = "127.0.0.1"):
+        self.transport_ip = transport_ip
+        self._server = None
+        self._connections: Dict[str, Any] = {}
+        self._offers: Dict[int, Any] = {}  # uuid -> arrays (keep-alive until acked)
+        self._lock = threading.Lock()
+
+    # --- lifecycle ----------------------------------------------------------
+    def _ensure_server(self):
+        with self._lock:
+            if self._server is None:
+                from jax.experimental import transfer
+
+                client = jax.devices()[0].client
+                self._server = transfer.start_transfer_server(
+                    client, "[::]:0", [f"{self.transport_ip}:0"]
+                )
+                logger.info("device transfer server on %s", self._server.address())
+            return self._server
+
+    @property
+    def address(self) -> str:
+        return self._ensure_server().address()
+
+    # --- producer side ------------------------------------------------------
+    def offer(self, request_id: str, arrays) -> dict:
+        """Schedule device arrays for one-sided pull. Returns the rendezvous
+        metadata to send to the consumer (RdmaMetadata role)."""
+        server = self._ensure_server()
+        uuid = _uuid_of(request_id)
+        flat = jax.tree.leaves(arrays)
+        server.await_pull(uuid, flat)
+        self._offers[uuid] = flat  # keep buffers alive until consumer acks
+        return {
+            "address": server.address(),
+            "uuid": uuid,
+            "specs": [{"shape": list(x.shape), "dtype": str(x.dtype)} for x in flat],
+        }
+
+    def release_offer(self, request_id: str) -> None:
+        self._offers.pop(_uuid_of(request_id), None)
+
+    # --- consumer side ------------------------------------------------------
+    def pull(self, meta: dict, sharding: Optional[jax.sharding.Sharding] = None):
+        """One-sided pull of the offered buffers onto local devices."""
+        import jax.numpy as jnp
+
+        server = self._ensure_server()
+        addr = meta["address"]
+        conn = self._connections.get(addr)
+        if conn is None:
+            conn = server.connect(addr)
+            self._connections[addr] = conn
+        sharding = sharding or jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        specs = [
+            jax.ShapeDtypeStruct(tuple(s["shape"]), jnp.dtype(s["dtype"]), sharding=sharding)
+            for s in meta["specs"]
+        ]
+        return conn.pull(meta["uuid"], specs)
+
+
+_plane: Optional[DeviceTransferPlane] = None
+
+
+def get_plane() -> DeviceTransferPlane:
+    """Process-wide singleton (the transfer server binds per process)."""
+    global _plane
+    if _plane is None:
+        _plane = DeviceTransferPlane()
+    return _plane

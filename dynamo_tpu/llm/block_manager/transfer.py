@@ -9,7 +9,7 @@ reuses the same executable.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,3 +60,56 @@ def _gather_k(k_cache: jax.Array, block_id: jax.Array) -> jax.Array:
 @jax.jit
 def _scatter_k(k_cache: jax.Array, block_id: jax.Array, k: jax.Array) -> jax.Array:
     return k_cache.at[:, block_id].set(k)
+
+
+# ---------------------------------------------------------------------------
+# Device-native block movement (the NIXL data-plane role): blocks never
+# leave the accelerator. Stacked layout [L, n, BS, KVH, HD] matches the
+# cache's own, so gather/scatter are single XLA ops (one fused DMA each).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _gather_many(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """[L, N, BS, ...] × [n] → [L, n, BS, ...]."""
+    return cache[:, block_ids]
+
+
+@jax.jit
+def _scatter_many(cache: jax.Array, block_ids: jax.Array, blocks: jax.Array) -> jax.Array:
+    return cache.at[:, block_ids].set(blocks)
+
+
+def gather_blocks_device(cache: KvCacheArrays, block_ids) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Stack blocks into fresh device arrays (no host round-trip). The copy
+    is independent of the cache, so the source blocks may be released
+    immediately while the stack awaits a remote pull."""
+    bids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+    k = _gather_many(cache.k, bids)
+    v = _gather_many(cache.v, bids) if _has_v(cache) else None
+    return k, v
+
+
+def scatter_blocks_device(cache: KvCacheArrays, block_ids, k_stack: jax.Array, v_stack) -> None:
+    """Write stacked device blocks into the cache (in-place on the handle)."""
+    bids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+    cache.k = _scatter_many(cache.k, bids, k_stack)
+    if v_stack is not None and _has_v(cache):
+        cache.v = _scatter_many(cache.v, bids, v_stack)
+
+
+@jax.jit
+def _copy_between(src_k, src_v, dst_k, dst_v, src_ids, dst_ids):
+    return dst_k.at[:, dst_ids].set(src_k[:, src_ids]), dst_v.at[:, dst_ids].set(src_v[:, src_ids])
+
+
+def copy_blocks_between(src: KvCacheArrays, src_ids, dst: KvCacheArrays, dst_ids) -> None:
+    """Same-process cache→cache block copy, entirely on device — the
+    fast path when prefill and decode engines share a host process
+    (ref: NIXL NVLink same-node transfers, dynamo_flow.md S8-S10)."""
+    s = jnp.asarray(list(src_ids), dtype=jnp.int32)
+    d = jnp.asarray(list(dst_ids), dtype=jnp.int32)
+    if _has_v(src):
+        dst.k, dst.v = _copy_between(src.k, src.v, dst.k, dst.v, s, d)
+    else:
+        dst.k = _scatter_many(dst.k, d, _gather_many(src.k, s))

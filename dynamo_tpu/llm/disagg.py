@@ -107,6 +107,12 @@ def kvx_subject(instance: Instance) -> str:
     return f"kvx.{instance.subject[3:]}"  # rq.<rest> → kvx.<rest>
 
 
+# Process-local exporter registry: a decode worker colocated with the
+# prefill worker hands KV over entirely on device, skipping wire + transfer
+# server (the NIXL same-node NVLink role).
+_LOCAL_EXPORTERS: dict = {}
+
+
 class KvExportService:
     """Prefill-worker side: serves KV pull requests over the data plane."""
 
@@ -117,6 +123,7 @@ class KvExportService:
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
+        _LOCAL_EXPORTERS[self.subject] = self
         sub = await self.drt.bus.subscribe(self.subject)
 
         async def loop():
@@ -134,6 +141,9 @@ class KvExportService:
         call_home = TcpCallHome(ConnectionInfo.from_dict(req["conn"]))
         try:
             if not await call_home.connect():
+                return
+            if req.get("mode") == "device":
+                await self._serve_pull_device(req, call_home)
                 return
             export = await self.engine.take_export(req["request_id"])
             if export is None:
@@ -156,7 +166,43 @@ class KvExportService:
         finally:
             await call_home.close()
 
+    async def _serve_pull_device(self, req: dict, call_home: TcpCallHome) -> None:
+        """Device-native pull: blocks stay on the accelerator. We offer the
+        stacked export on the transfer plane and send only the rendezvous
+        metadata down the wire; the decode worker pulls device-to-device
+        (ref: NIXL one-sided GET under vllm handlers.py:153-204)."""
+        from dynamo_tpu.llm.block_manager.device_transfer import get_plane
+
+        rid = req["request_id"]
+        export = await self.engine.take_export_device(rid)
+        if export is None:
+            await call_home.error(f"no export for {rid}")
+            return
+        (k_stack, v_stack), _hashes, prompt_len = export
+        plane = get_plane()
+        arrays = [k_stack] if v_stack is None else [k_stack, v_stack]
+        meta = await asyncio.to_thread(plane.offer, rid, arrays)
+        ack_sub = await self.drt.bus.subscribe(f"kvx_ack.{rid}")
+        await call_home.send(
+            {"seq": 0, "total": 1, "mode": "device", "meta": meta,
+             "prompt_len": prompt_len, "has_v": v_stack is not None},
+            b"",
+        )
+        await call_home.complete()
+
+        async def reap():
+            # Hold the offered buffers until the consumer acks the pull (or
+            # a TTL passes — consumer died mid-pull).
+            try:
+                await ack_sub.next(timeout=60.0)
+            finally:
+                plane.release_offer(rid)
+                await ack_sub.unsubscribe()
+
+        asyncio.get_running_loop().create_task(reap())
+
     async def stop(self) -> None:
+        _LOCAL_EXPORTERS.pop(self.subject, None)
         if self._task is not None:
             await self._sub.unsubscribe()
             self._task.cancel()
@@ -168,7 +214,7 @@ class KvExportService:
 
 async def pull_kv_blocks(drt, instance: Instance, request_id: str) -> List[Tuple[np.ndarray, np.ndarray]]:
     """Decode-worker side: pull the prefilled KV blocks for ``request_id``
-    from the prefill worker that computed them."""
+    from the prefill worker that computed them (host-numpy wire path)."""
     conn_info, pending = drt.tcp_server_handle().register()
     await drt.bus.publish(
         kvx_subject(instance),
@@ -189,6 +235,51 @@ async def pull_kv_blocks(drt, instance: Instance, request_id: str) -> List[Tuple
     finally:
         drt.tcp_server_handle().unregister(conn_info.stream_id)
     return blocks
+
+
+async def pull_kv_blocks_device(drt, instance: Instance, request_id: str):
+    """Device-native pull: request rendezvous metadata over the control
+    wire, then one-sided device-to-device pull via the transfer plane.
+    Returns (k_stack, v_stack|None) device arrays."""
+    from dynamo_tpu.llm.block_manager.device_transfer import get_plane
+
+    # Same-process exporter: hand the stacked device arrays over directly —
+    # no wire, no transfer server, zero host bytes.
+    svc = _LOCAL_EXPORTERS.get(kvx_subject(instance))
+    if svc is not None:
+        export = await svc.engine.take_export_device(request_id)
+        if export is None:
+            raise RuntimeError(f"no export for {request_id}")
+        (k_stack, v_stack), _hashes, _plen = export
+        return k_stack, v_stack
+
+    conn_info, pending = drt.tcp_server_handle().register()
+    await drt.bus.publish(
+        kvx_subject(instance),
+        msgpack.packb(
+            {"request_id": request_id, "conn": conn_info.to_dict(), "mode": "device"},
+            use_bin_type=True,
+        ),
+    )
+    meta = None
+    has_v = True
+    try:
+        async for frame in pending.frames():
+            if frame.kind == "data":
+                meta = frame.header["meta"]
+                has_v = bool(frame.header.get("has_v", True))
+            elif frame.kind == "error":
+                raise RuntimeError(frame.header.get("message", "kv pull failed"))
+    finally:
+        drt.tcp_server_handle().unregister(conn_info.stream_id)
+    if meta is None:
+        raise RuntimeError("device kv pull: no rendezvous metadata received")
+    plane = get_plane()
+    arrays = await asyncio.to_thread(plane.pull, meta)
+    await drt.bus.publish(f"kvx_ack.{request_id}", b"1")
+    if has_v:
+        return arrays[0], arrays[1]
+    return arrays[0], None
 
 
 # ---------------------------------------------------------------------------
@@ -228,12 +319,21 @@ class PrefillQueueWorker:
         self.drt = drt
         self.engine = engine
         self.instance = instance
+        self.queue_name = queue_name
+        self.lease_id = lease_id
         self.queue = WorkQueue(drt.store, drt.bus, queue_name, lease_id=lease_id)
         self.jobs_served = 0
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
 
     async def start(self) -> None:
+        # Advertise liveness so decode workers only enqueue when someone can
+        # pull (leased ⇒ the registration dies with us).
+        await self.drt.store.put(
+            f"wq/{self.queue_name}/workers/{self.instance.instance_id:x}",
+            b"",
+            lease_id=self.lease_id,
+        )
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def _loop(self) -> None:
@@ -293,9 +393,16 @@ class DisaggDecodeHandler:
         strategy: str = "decode_first",
         prefill_queue_name: str = PREFILL_QUEUE,
         queue_reply_timeout_s: float = 30.0,
+        kv_transfer: str = "device",
     ):
         if strategy not in ("decode_first", "prefill_first"):
             raise ValueError(f"unknown disagg strategy: {strategy}")
+        if kv_transfer not in ("device", "host"):
+            raise ValueError(f"unknown kv_transfer mode: {kv_transfer}")
+        # "device": blocks move accelerator-to-accelerator (in-process direct
+        # handoff, else jax transfer server — the NIXL path). "host": numpy
+        # over the TCP response plane (debug / heterogeneous fallback).
+        self.kv_transfer = kv_transfer
         self.drt = drt
         self.engine = engine
         self.prefill_client = prefill_client
@@ -306,12 +413,28 @@ class DisaggDecodeHandler:
             WorkQueue(drt.store, drt.bus, prefill_queue_name) if strategy == "prefill_first" else None
         )
         self.queue_reply_timeout_s = queue_reply_timeout_s
+        self.prefill_queue_name = prefill_queue_name
         self.remote_prefills = 0
         self.local_prefills = 0
+        # prefill_first liveness: cached queue-worker presence + timeout
+        # backoff, so a pool with zero pull workers doesn't cost every request
+        # the full queue_reply_timeout_s of TTFT before local fallback.
+        self._liveness_cache: Tuple[float, bool] = (0.0, False)
+        self._liveness_ttl_s = 2.0
+        self._backoff_until = 0.0
+        self.queue_backoff_s = 15.0
 
-    def can_prefill_remote(self) -> bool:
+    async def can_prefill_remote(self) -> bool:
         if self.strategy == "prefill_first":
-            return True  # any live queue worker can pull; absence ⇒ timeout fallback
+            now = time.monotonic()
+            if now < self._backoff_until:
+                return False
+            ts, alive = self._liveness_cache
+            if now - ts > self._liveness_ttl_s:
+                workers = await self.drt.store.get_prefix(f"wq/{self.prefill_queue_name}/workers/")
+                alive = bool(workers)
+                self._liveness_cache = (now, alive)
+            return alive
         return self.prefill_router is not None and bool(self.prefill_client.instances)
 
     async def _prefill_via_push(self, prefill_req: dict, prefill_ctx: Context) -> Tuple[int, Instance]:
@@ -344,10 +467,11 @@ class DisaggDecodeHandler:
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         tokens = list(request.get("token_ids") or [])
+        can_remote = await self.can_prefill_remote()
         remote = (
-            self.disagg_router.prefill_remote(len(tokens), self.can_prefill_remote())
+            self.disagg_router.prefill_remote(len(tokens), can_remote)
             if self.disagg_router is not None
-            else self.can_prefill_remote()
+            else can_remote
         )
         if not remote:
             self.local_prefills += 1
@@ -368,18 +492,30 @@ class DisaggDecodeHandler:
             else:
                 first_token, instance = await self._prefill_via_push(prefill_req, prefill_ctx)
             # 2) Pull the KV blocks (the NIXL-transfer step).
-            blocks = await pull_kv_blocks(self.drt, instance, prefill_ctx.id)
+            if self.kv_transfer == "device":
+                device_blocks = await pull_kv_blocks_device(self.drt, instance, prefill_ctx.id)
+                blocks = None
+            else:
+                blocks = await pull_kv_blocks(self.drt, instance, prefill_ctx.id)
         except (NoInstancesError, ConnectionError, RuntimeError) as e:
             # Prefill pool failed — degrade to local prefill (availability
-            # over disagg, matching the reference's fallback).
+            # over disagg, matching the reference's fallback). A queue-reply
+            # timeout means registered workers aren't actually pulling: back
+            # off so subsequent requests skip straight to local.
+            if self.strategy == "prefill_first" and "timed out" in str(e):
+                self._backoff_until = time.monotonic() + self.queue_backoff_s
             logger.warning("remote prefill failed (%s); running locally", e)
+            self.local_prefills += 1
             async for item in self.engine.generate(request, context):
                 yield item
             return
 
         # 3) Continue decode locally from the injected KV.
         local_req = dict(request)
-        local_req["_prefilled"] = {"first_token": first_token, "blocks": blocks}
+        if blocks is not None:
+            local_req["_prefilled"] = {"first_token": first_token, "blocks": blocks}
+        else:
+            local_req["_prefilled"] = {"first_token": first_token, "device_blocks": device_blocks}
         async for item in self.engine.generate(local_req, context):
             yield item
 

@@ -17,6 +17,7 @@ from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.llm.kv_router.approx import ApproxKvIndexer
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.llm.kv_router.prefill_counter import PrefillCountersMultiWorker
 from dynamo_tpu.llm.kv_router.publisher import (
     KvEventPublisher,
     WorkerMetricsPublisher,
@@ -25,6 +26,7 @@ from dynamo_tpu.llm.kv_router.publisher import (
 )
 from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, SchedulingDecision
 from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.llm.kv_router.sharded import KvIndexerSharded
 from dynamo_tpu.llm.kv_router.subscriber import KvRouterSubscriber
 from dynamo_tpu.llm.tokens import compute_block_hashes
 from dynamo_tpu.runtime.client import Client
@@ -38,6 +40,8 @@ __all__ = [
     "KvRouterConfig",
     "KvPushRouter",
     "KvIndexer",
+    "KvIndexerSharded",
+    "PrefillCountersMultiWorker",
     "ApproxKvIndexer",
     "RadixTree",
     "OverlapScores",
@@ -62,6 +66,12 @@ class KvRouterConfig:
     approx_ttl_s: float = 120.0
     snapshot_threshold: int = 1_000_000
     reset_states: bool = False
+    # >1 ⇒ KvIndexerSharded: parallel event appliers, worker-pinned shards
+    # (ref: indexer.rs:970 KvIndexerSharded).
+    num_indexer_shards: int = 1
+    # Gossip pending prefills between replicated routers so they don't
+    # stampede one worker (ref: prefill_counter.rs).
+    track_prefill_counters: bool = False
 
 
 class KvPushRouter:
@@ -77,10 +87,15 @@ class KvPushRouter:
             overlap_score_weight=config.overlap_score_weight,
             temperature=config.temperature,
         )
-        if config.use_kv_events:
-            self.indexer: KvIndexer = KvIndexer(block_size=config.block_size)
-        else:
+        if not config.use_kv_events:
             self.indexer = ApproxKvIndexer(block_size=config.block_size, ttl_s=config.approx_ttl_s)
+        elif config.num_indexer_shards > 1:
+            self.indexer = KvIndexerSharded(
+                block_size=config.block_size, num_shards=config.num_indexer_shards
+            )
+        else:
+            self.indexer: KvIndexer = KvIndexer(block_size=config.block_size)
+        self.prefill_counters: Optional[PrefillCountersMultiWorker] = None
         self.subscriber: Optional[KvRouterSubscriber] = None
         self._metrics_task: Optional[asyncio.Task] = None
 
@@ -98,6 +113,10 @@ class KvPushRouter:
                 reset_states=config.reset_states,
             )
             await router.subscriber.start()
+        if config.track_prefill_counters:
+            ep = client.endpoint
+            router.prefill_counters = PrefillCountersMultiWorker(client.drt, ep.namespace, ep.component)
+            await router.prefill_counters.start()
         router._metrics_task = asyncio.get_running_loop().create_task(router._consume_metrics())
         return router
 
@@ -126,6 +145,8 @@ class KvPushRouter:
             if w not in live_set:
                 self.sequences.remove_worker(w)
                 self.indexer.remove_worker(w)
+                if self.prefill_counters is not None:
+                    self.prefill_counters.remove_worker(w)
         for w in live:
             self.sequences.ensure_worker(w)
         return live
@@ -136,12 +157,18 @@ class KvPushRouter:
         prompt_blocks = max(1, (len(token_ids) + self.config.block_size - 1) // self.config.block_size)
         overlaps = self.indexer.find_matches(hashes)
         overrides = router_overrides or {}
+        external = (
+            {w: self.prefill_counters.pending_tokens(w) for w in workers}
+            if self.prefill_counters is not None
+            else None
+        )
         return self.scheduler.select_worker(
             workers,
             prompt_blocks,
             overlaps,
             overlap_score_weight=overrides.get("overlap_score_weight"),
             temperature=overrides.get("temperature"),
+            external_prefill_tokens=external,
         )
 
     async def generate(self, request: Any, context: Optional[Context] = None) -> AsyncIterator[Annotated]:
@@ -152,6 +179,8 @@ class KvPushRouter:
         self.sequences.add_request(rid, decision.worker, len(token_ids), decision.overlap_blocks)
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision(decision.worker, token_ids)
+        if self.prefill_counters is not None:
+            await self.prefill_counters.new_prefill(rid, decision.worker, len(token_ids))
         logger.debug(
             "kv-routed %s -> %x (overlap=%d blocks, cost=%.1f)", rid, decision.worker, decision.overlap_blocks, decision.cost
         )
@@ -160,14 +189,24 @@ class KvPushRouter:
             async for item in self.push.generate(request, ctx, instance_id=decision.worker):
                 if first and (not isinstance(item, Annotated) or not item.is_annotation()):
                     self.sequences.mark_prefill_done(rid)
+                    if self.prefill_counters is not None:
+                        await self.prefill_counters.complete_prefill(rid, decision.worker)
                     first = False
                 yield item
         finally:
             self.sequences.free(rid)
+            if first and self.prefill_counters is not None:
+                # Stream ended before the first token (abort/error): retract
+                # the pending-prefill gossip too.
+                await self.prefill_counters.complete_prefill(rid, decision.worker)
 
     async def close(self) -> None:
         if self.subscriber is not None:
             await self.subscriber.stop()
+        if self.prefill_counters is not None:
+            await self.prefill_counters.stop()
+        if isinstance(self.indexer, KvIndexerSharded):
+            self.indexer.close()
         if self._metrics_task is not None:
             self._metrics_task.cancel()
             try:

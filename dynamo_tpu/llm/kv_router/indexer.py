@@ -248,3 +248,17 @@ class KvIndexer:
 
     def remove_worker(self, worker: WorkerId) -> None:
         self.tree.remove_worker(worker)
+
+    # Snapshot surface shared with KvIndexerSharded (subscriber.py calls
+    # these so either indexer flavor can sit under the event stream).
+    def dump(self) -> bytes:
+        return self.tree.dump()
+
+    def load_snapshot(self, raw: bytes) -> None:
+        self.tree = load_radix(raw)
+
+    def size(self) -> int:
+        return self.tree.size()
+
+    def flush(self) -> None:
+        pass  # synchronous applier: nothing queued

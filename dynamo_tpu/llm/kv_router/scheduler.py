@@ -54,11 +54,13 @@ class KvScheduler:
         *,
         overlap_score_weight: Optional[float] = None,
         temperature: Optional[float] = None,
+        external_prefill_tokens: Optional[Dict[WorkerId, int]] = None,
     ) -> SchedulingDecision:
         if not workers:
             raise ValueError("no workers to select from")
         w_weight = self.overlap_score_weight if overlap_score_weight is None else overlap_score_weight
         temp = self.temperature if temperature is None else temperature
+        external = external_prefill_tokens or {}
 
         costs: List[Tuple[WorkerId, float, int]] = []
         for w in workers:
@@ -66,8 +68,11 @@ class KvScheduler:
             potential_prefill_blocks = prompt_blocks - overlap
             decode_blocks = self.sequences.decode_blocks(w)
             # Pending prefill tokens keep the cost honest between metric
-            # updates (same term the reference folds in via ActiveSequences).
-            pending_prefill_blocks = self.sequences.prefill_tokens(w) / max(self.sequences.block_size, 1)
+            # updates (same term the reference folds in via ActiveSequences),
+            # plus other routers' gossiped pending prefills
+            # (ref: prefill_counter.rs PrefillCountersMultiWorker).
+            pending = self.sequences.prefill_tokens(w) + external.get(w, 0)
+            pending_prefill_blocks = pending / max(self.sequences.block_size, 1)
             cost = w_weight * (potential_prefill_blocks + pending_prefill_blocks) + decode_blocks
             costs.append((w, cost, overlap))
 

@@ -15,7 +15,7 @@ import asyncio
 import json
 from typing import Optional
 
-from dynamo_tpu.llm.kv_router.indexer import KvIndexer, load_radix
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.transports.kvstore import KeyExists
 
@@ -54,8 +54,8 @@ class KvRouterSubscriber:
             snap = await bucket.get(self.stream_name)
             if snap is not None:
                 try:
-                    self.indexer.tree = load_radix(snap)
-                    logger.info("restored radix snapshot: %d nodes", self.indexer.tree.size())
+                    self.indexer.load_snapshot(snap)
+                    logger.info("restored radix snapshot: %d nodes", self.indexer.size())
                 except Exception:
                     logger.exception("radix snapshot restore failed; starting empty")
         self._task = asyncio.get_running_loop().create_task(self._consume())
@@ -85,11 +85,14 @@ class KvRouterSubscriber:
         except KeyExists:
             return  # another replica is snapshotting
         try:
+            # Quiesce async appliers (sharded indexer) so the snapshot holds
+            # everything up to _consumed_seq before the stream is purged.
+            self.indexer.flush()
             bucket = await self.drt.bus.object_store(RADIX_STATE_BUCKET)
-            await bucket.put(self.stream_name, self.indexer.tree.dump())
+            await bucket.put(self.stream_name, self.indexer.dump())
             await stream.purge(up_to_seq=self._consumed_seq)
             logger.info("radix snapshot uploaded (%d nodes), stream purged to %d",
-                        self.indexer.tree.size(), self._consumed_seq)
+                        self.indexer.size(), self._consumed_seq)
         finally:
             await self.drt.store.delete(ROUTER_SNAPSHOT_LOCK)
 

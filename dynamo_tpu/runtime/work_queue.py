@@ -10,6 +10,10 @@ Design on this runtime's primitives (no new transport surface):
 - A claim is an atomic create-only KV key ``wq/{name}/claim/{seq}`` bound to
   the consumer's lease: two consumers can never claim the same item, and a
   dead consumer's claim evaporates with its lease so the item is redelivered.
+- Consumers without a lease get a claim *deadline* instead (``claim_ttl_s``,
+  stored in the claim value): a consumer that crashes between claim and ack
+  only delays redelivery until the deadline passes — items are never
+  orphaned either way.
 - Ack writes ``wq/{name}/done/{seq}`` (unleased — completion survives the
   worker) and drops the claim; fully-acked prefixes are purged from the
   stream opportunistically.
@@ -43,11 +47,21 @@ class WorkQueue:
     delivered to exactly one live consumer (redelivered if that consumer's
     lease dies before ack)."""
 
-    def __init__(self, store: KvStore, bus: PubSub, name: str, lease_id: Optional[int] = None):
+    def __init__(
+        self,
+        store: KvStore,
+        bus: PubSub,
+        name: str,
+        lease_id: Optional[int] = None,
+        claim_ttl_s: float = 60.0,
+    ):
         self.store = store
         self.bus = bus
         self.name = name
         self.lease_id = lease_id
+        # Deadline for lease-less claims: a crashed consumer's claim is
+        # reclaimable after this long. Leased claims expire with the lease.
+        self.claim_ttl_s = claim_ttl_s
         self._stream: Optional[Stream] = None
         self._cursor = 1  # lowest seq that might still be claimable
 
@@ -95,17 +109,29 @@ class WorkQueue:
     async def _try_claim(self, stream: Stream) -> Optional[QueueItem]:
         batch = await stream.fetch(max(self._cursor, stream.first_seq))
         advance = True
+        now = time.time()
         for msg in batch:
             if await self.store.get(self._done_key(msg.seq)) is not None:
                 if advance:
                     self._cursor = msg.seq + 1
                 continue
-            if await self.store.get(self._claim_key(msg.seq)) is not None:
-                advance = False  # claimed by a peer; may still come back
-                continue
+            existing = await self.store.get(self._claim_key(msg.seq))
+            if existing is not None:
+                # Lease-less claims carry a deadline; expired ⇒ the claimant
+                # died between claim and ack — steal it. (Delete + create_only
+                # races resolve atomically: one thief wins, others KeyExists.)
+                try:
+                    expired = existing.value and float(existing.value) < now
+                except ValueError:
+                    expired = False
+                if not expired:
+                    advance = False  # live claim by a peer; may still come back
+                    continue
+                await self.store.delete(self._claim_key(msg.seq))
+            claim_val = b"" if self.lease_id is not None else str(now + self.claim_ttl_s).encode()
             try:
                 await self.store.put(
-                    self._claim_key(msg.seq), b"", lease_id=self.lease_id, create_only=True
+                    self._claim_key(msg.seq), claim_val, lease_id=self.lease_id, create_only=True
                 )
             except KeyExists:
                 advance = False

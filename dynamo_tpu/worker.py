@@ -48,6 +48,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
     p.add_argument("--max-local-prefill-length", type=int, default=0)
     p.add_argument("--speedup-ratio", type=float, default=1.0, help="mocker time compression")
+    p.add_argument("--kv-transfer", choices=["device", "host"], default="device",
+                   help="disagg KV plane: device-native (NIXL role) or host-numpy over TCP")
+    # Intra-engine parallelism (sharded serving over a device mesh).
+    p.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    p.add_argument("--dp", type=int, default=1, help="data parallel size (within this process's mesh)")
+    p.add_argument("--ep", type=int, default=1, help="expert parallel size")
+    p.add_argument("--pp", type=int, default=1, help="pipeline parallel size")
+    # Multi-host (ref: MultiNodeConfig engines.rs:28): either pass explicit
+    # --num-processes/--process-id/--coordinator, or just --num-processes
+    # and let store-based rendezvous elect ranks + coordinator.
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--coordinator", default=None, help="leader host:port for jax.distributed")
+    p.add_argument("--multihost-group", default="default")
     return p
 
 
@@ -55,11 +69,26 @@ async def amain(args) -> None:
     drt = await DistributedRuntime.from_settings()
     drt.runtime.install_signal_handlers()
 
+    if args.num_processes > 1:
+        # Join the multi-controller runtime BEFORE any jax backend touch.
+        from dynamo_tpu.engine.multihost import MultiHostConfig, init_multihost, rendezvous
+
+        if args.process_id is not None and args.coordinator:
+            mh = MultiHostConfig(args.num_processes, args.process_id, args.coordinator)
+        else:
+            mh = await rendezvous(drt, args.multihost_group, args.num_processes)
+        init_multihost(mh)
+
     if args.mocker:
         engine = MockTpuEngine(
             MockEngineArgs(num_blocks=args.num_blocks, block_size=args.block_size, speedup_ratio=args.speedup_ratio)
         )
     else:
+        parallel = None
+        if args.tp * args.dp * args.ep * args.pp > 1:
+            from dynamo_tpu.engine.sharding import ParallelConfig
+
+            parallel = ParallelConfig(tp=args.tp, dp=args.dp, ep=args.ep, pp=args.pp)
         engine = TpuEngine.build(
             EngineArgs(
                 model=args.model,
@@ -69,6 +98,7 @@ async def amain(args) -> None:
                 kvbm_disk_dir=args.kvbm_disk_dir,
                 kvbm_disk_blocks=args.kvbm_disk_blocks,
                 scheduler=SchedulerConfig(num_blocks=args.num_blocks, max_running=args.max_running),
+                parallel=parallel,
             )
         )
 
@@ -86,7 +116,9 @@ async def amain(args) -> None:
             conf=DisaggRouterConf(max_local_prefill_length=args.max_local_prefill_length),
         )
         await disagg_router.start()
-        handler = DisaggDecodeHandler(drt, engine, prefill_client, disagg_router)
+        handler = DisaggDecodeHandler(
+            drt, engine, prefill_client, disagg_router, kv_transfer=args.kv_transfer
+        )
 
     card = ModelDeploymentCard(
         name=args.served_model_name or args.model,

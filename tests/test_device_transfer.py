@@ -1,0 +1,185 @@
+"""Device-native KV transfer plane (the NIXL replacement).
+
+- stacked device gather/scatter + cache→cache copy primitives
+- in-process disagg e2e over the device handoff (token parity)
+- cross-process one-sided pull via jax.experimental.transfer (two
+  subprocesses, CPU backend)
+Ref: nixl_connect/__init__.py:501-1417; SURVEY.md §7 hard part (a).
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.llm.block_manager.transfer import (
+    copy_blocks_between,
+    gather_blocks,
+    gather_blocks_device,
+    scatter_blocks_device,
+)
+
+
+def filled_cache(cfg, num_blocks, seed):
+    cache = KvCacheArrays.create(cfg, num_blocks, dtype=jnp.float32)
+    shape = cache.k.shape
+    cache.k = jax.random.normal(jax.random.PRNGKey(seed), shape, dtype=jnp.float32)
+    cache.v = jax.random.normal(jax.random.PRNGKey(seed + 1), shape, dtype=jnp.float32)
+    return cache
+
+
+def test_gather_scatter_device_roundtrip():
+    cfg = get_config("tiny")
+    src = filled_cache(cfg, 16, 0)
+    dst = KvCacheArrays.create(cfg, 16, dtype=jnp.float32)
+
+    bids = [3, 7, 2]
+    k_stack, v_stack = gather_blocks_device(src, bids)
+    assert k_stack.shape == (cfg.num_layers, 3, cfg.block_size, cfg.num_kv_heads, cfg.head_dim)
+
+    dst_bids = [1, 4, 9]
+    scatter_blocks_device(dst, dst_bids, k_stack, v_stack)
+    for sb, db in zip(bids, dst_bids):
+        ks, _ = gather_blocks(src, sb)
+        kd, _ = gather_blocks(dst, db)
+        np.testing.assert_array_equal(ks, kd)
+
+
+def test_copy_blocks_between_caches():
+    cfg = get_config("tiny")
+    src = filled_cache(cfg, 16, 2)
+    dst = KvCacheArrays.create(cfg, 32, dtype=jnp.float32)
+    copy_blocks_between(src, [5, 6], dst, [20, 21])
+    k5, v5 = gather_blocks(src, 5)
+    k20, v20 = gather_blocks(dst, 20)
+    np.testing.assert_array_equal(k5, k20)
+    np.testing.assert_array_equal(v5, v20)
+
+
+async def test_disagg_device_handoff_matches_aggregated():
+    """Full disagg flow with kv_transfer='device' (in-process direct
+    handoff): output must be token-identical to aggregated serving."""
+    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.llm.disagg import DisaggDecodeHandler, KvExportService
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import Context
+
+    def build_engine():
+        return TpuEngine.build(
+            EngineArgs(
+                model="tiny", dtype="float32", seed=7,
+                scheduler=SchedulerConfig(num_blocks=64, prefill_buckets=[16, 32, 64],
+                                          decode_buckets=[1, 2, 4, 8],
+                                          enable_prefix_caching=False),
+            )
+        )
+
+    async def collect(engine_like, request):
+        out, fin = [], None
+        async for frame in engine_like.generate(request, Context()):
+            data = frame.data if hasattr(frame, "data") else frame
+            if data:
+                out.extend(data.get("token_ids") or [])
+                fin = data.get("finish_reason") or fin
+        return out, fin
+
+    req = {
+        "token_ids": list(range(20, 60)),
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": 6},
+    }
+
+    drt = await DistributedRuntime.detached()
+    try:
+        prefill_engine = build_engine()
+        decode_engine = build_engine()
+        ep = drt.namespace("dxd").component("prefill").endpoint("generate")
+        handle = await ep.serve_endpoint(prefill_engine.generate, stats_handler=prefill_engine.stats_handler)
+        kvx = KvExportService(drt, prefill_engine, handle.instance)
+        await kvx.start()
+
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        handler = DisaggDecodeHandler(drt, decode_engine, client, kv_transfer="device")
+
+        ref_engine = build_engine()
+        ref, _ = await collect(ref_engine, req)
+        await ref_engine.stop()
+
+        out, fin = await collect(handler, req)
+        assert out == ref, f"device disagg {out} != aggregated {ref}"
+        assert fin == "length"
+        assert handler.remote_prefills == 1
+        assert prefill_engine.scheduler.allocator.num_active == 0
+        assert not prefill_engine.scheduler._pending_exports
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+PRODUCER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import sys, time
+    from dynamo_tpu.llm.block_manager.device_transfer import DeviceTransferPlane
+
+    plane = DeviceTransferPlane()
+    x = jnp.arange(65536, dtype=jnp.float32).reshape(64, 1024)
+    meta = plane.offer("req-x", [x])
+    import json
+    print(json.dumps(meta), flush=True)
+    time.sleep(15)
+""")
+
+CONSUMER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import json, sys
+    import numpy as np
+    from dynamo_tpu.llm.block_manager.device_transfer import DeviceTransferPlane
+
+    meta = json.loads(sys.argv[1])
+    plane = DeviceTransferPlane()
+    out = plane.pull(meta)
+    expect = np.arange(65536, dtype=np.float32).reshape(64, 1024)
+    assert (np.asarray(out[0]) == expect).all(), "payload mismatch"
+    print("PULL_OK", flush=True)
+""")
+
+
+def test_cross_process_device_pull():
+    """Two processes: producer offers device buffers, consumer pulls them
+    one-sided through the transfer plane (the NIXL wire)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    prod = subprocess.Popen(
+        [sys.executable, "-c", PRODUCER], stdout=subprocess.PIPE, env=env, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        meta_line = prod.stdout.readline().strip()
+        assert meta_line.startswith("{"), f"producer output: {meta_line!r}"
+        cons = subprocess.run(
+            [sys.executable, "-c", CONSUMER, meta_line],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert "PULL_OK" in cons.stdout, f"consumer failed: {cons.stdout}\n{cons.stderr}"
+    finally:
+        prod.kill()
+        prod.wait()

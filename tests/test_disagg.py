@@ -3,6 +3,7 @@ aggregated, token-exact), conditional disagg, prefill-pool fallback.
 Ref: SURVEY.md §3C + tests/serve disagg coverage."""
 
 import asyncio
+import time
 
 import pytest
 
@@ -186,15 +187,43 @@ async def test_prefill_first_queue_matches_aggregated():
 
 
 async def test_prefill_first_no_workers_falls_back_local():
+    """Zero live queue workers ⇒ immediate local prefill — the request must
+    NOT pay queue_reply_timeout_s of TTFT discovering nobody will pull."""
+    drt = await DistributedRuntime.detached()
+    try:
+        decode_engine = build_engine()
+        handler = DisaggDecodeHandler(
+            drt, decode_engine, strategy="prefill_first", queue_reply_timeout_s=30.0
+        )
+        t0 = time.monotonic()
+        out, fin = await collect(handler, req(list(range(40))))
+        assert len(out) == 6 and fin == "length"
+        assert time.monotonic() - t0 < 5.0  # no 30s queue timeout paid
+        assert handler.remote_prefills == 0 and handler.local_prefills == 1
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_prefill_first_backoff_after_timeout():
+    """A live-looking registration whose worker never replies triggers the
+    timeout once, then the handler backs off to local for subsequent calls."""
     drt = await DistributedRuntime.detached()
     try:
         decode_engine = build_engine()
         handler = DisaggDecodeHandler(
             drt, decode_engine, strategy="prefill_first", queue_reply_timeout_s=0.3
         )
+        # Stale-but-live registration (no actual worker pulling).
+        await drt.store.put("wq/prefill/workers/dead", b"")
         out, fin = await collect(handler, req(list(range(40))))
         assert len(out) == 6 and fin == "length"
-        assert handler.remote_prefills == 1  # attempted, then degraded
+        assert handler.remote_prefills == 1  # attempted, timed out, degraded
+        assert handler._backoff_until > time.monotonic()
+        # Second request: inside the backoff window ⇒ straight to local.
+        out, fin = await collect(handler, req(list(range(40, 80))))
+        assert len(out) == 6
+        assert handler.remote_prefills == 1 and handler.local_prefills == 2
         await decode_engine.stop()
     finally:
         await drt.shutdown()

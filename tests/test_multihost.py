@@ -1,0 +1,115 @@
+"""Multi-host coordination: jax.distributed 2-process CPU mesh + store
+rendezvous (ref: engines.rs:28 MultiNodeConfig, trtllm multinode srun)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+from dynamo_tpu.engine.multihost import MultiHostConfig, build_multihost_mesh, rendezvous
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank = int(sys.argv[1]); coord = sys.argv[2]
+
+    from dynamo_tpu.engine.multihost import MultiHostConfig, init_multihost
+    cfg = MultiHostConfig(num_processes=2, process_id=rank, coordinator=coord)
+    init_multihost(cfg)
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    # Sharded compute across both processes: global psum over a dp×tp mesh.
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental import mesh_utils
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), axis_names=("dp", "tp"))
+    x = jnp.arange(16.0, dtype=jnp.float32).reshape(8, 2)
+    sharding = NamedSharding(mesh, P("dp", None))
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    xs = jax.device_put(x, sharding)
+    out = total(xs)
+    expect = float(np.arange(16.0).sum())
+    assert float(out) == expect, (float(out), expect)
+    print(f"RANK{rank}_OK", flush=True)
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cpu_mesh():
+    """Two OS processes join one jax.distributed runtime (the multi-host
+    serving topology) and run a jitted global reduction over a 2×4 mesh."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    coord = f"127.0.0.1:{_free_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(rank), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True, cwd=repo,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"RANK{rank}_OK" in out
+
+
+async def test_rendezvous_assigns_dense_ranks():
+    drt = await DistributedRuntime.detached()
+    try:
+        a = await rendezvous(drt, "grp", 3)
+        b = await rendezvous(drt, "grp", 3)
+        c = await rendezvous(drt, "grp", 3)
+        assert sorted([a.process_id, b.process_id, c.process_id]) == [0, 1, 2]
+        assert a.coordinator == b.coordinator == c.coordinator
+        assert a.num_processes == 3
+        # Leader flag follows rank 0.
+        leaders = [x for x in (a, b, c) if x.is_leader]
+        assert len(leaders) == 1
+    finally:
+        await drt.shutdown()
+
+
+async def test_rendezvous_full_group_times_out():
+    drt = await DistributedRuntime.detached()
+    try:
+        await rendezvous(drt, "g2", 1)
+        import pytest
+
+        with pytest.raises(TimeoutError):
+            await rendezvous(drt, "g2", 1, timeout_s=0.3)
+    finally:
+        await drt.shutdown()
+
+
+def test_build_multihost_mesh_single_slice():
+    cfg = MultiHostConfig()
+    assert not cfg.enabled and cfg.is_leader
+    from dynamo_tpu.engine.sharding import ParallelConfig
+
+    mesh = build_multihost_mesh(ParallelConfig(tp=2, dp=2), dcn_dp=2)
+    assert dict(mesh.shape) == {"dp": 4, "pp": 1, "sp": 1, "ep": 1, "tp": 2}

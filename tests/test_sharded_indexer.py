@@ -1,0 +1,151 @@
+"""Sharded indexer + inter-router prefill counters (ref: indexer.rs:970
+KvIndexerSharded, prefill_counter.rs PrefillCountersMultiWorker)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import KvIndexer, KvIndexerSharded, KvScheduler
+from dynamo_tpu.llm.kv_router.prefill_counter import (
+    PrefillCountersMultiWorker,
+    prefill_events_subject,
+)
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.llm.tokens import compute_block_hashes
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+BS = 16
+
+
+def ev_stored(tokens, parent=None):
+    return {"kind": "stored", "block_hashes": compute_block_hashes(tokens, BS), "parent_hash": parent}
+
+
+def test_sharded_matches_unsharded():
+    plain = KvIndexer(block_size=BS)
+    sharded = KvIndexerSharded(block_size=BS, num_shards=3)
+    try:
+        seqs = {w: list(range(w, w + 64)) for w in range(1, 8)}
+        for w, toks in seqs.items():
+            plain.apply_event(w, ev_stored(toks))
+            sharded.apply_event(w, ev_stored(toks))
+        sharded.flush()
+        for toks in seqs.values():
+            h = compute_block_hashes(toks, BS)
+            assert sharded.find_matches(h).scores == plain.find_matches(h).scores
+        assert sharded.size() == plain.size()
+    finally:
+        sharded.close()
+
+
+def test_sharded_worker_pinning_and_removal():
+    idx = KvIndexerSharded(block_size=BS, num_shards=2)
+    try:
+        toks = list(range(48))
+        for w in (1, 2, 3, 4):
+            idx.apply_event(w, ev_stored(toks))
+        idx.flush()
+        # Workers balance across shards.
+        assert sorted(idx._counts) == [2, 2]
+        h = compute_block_hashes(toks, BS)
+        assert set(idx.find_matches(h).scores) == {1, 2, 3, 4}
+
+        idx.remove_worker(2)
+        idx.flush()
+        assert set(idx.find_matches(h).scores) == {1, 3, 4}
+        assert sorted(idx._counts) == [1, 2]
+    finally:
+        idx.close()
+
+
+def test_sharded_removed_events_and_snapshot_roundtrip():
+    idx = KvIndexerSharded(block_size=BS, num_shards=2)
+    idx2 = KvIndexerSharded(block_size=BS, num_shards=3)
+    try:
+        toks = list(range(64))
+        h = compute_block_hashes(toks, BS)
+        idx.apply_event(1, ev_stored(toks))
+        idx.apply_event(2, ev_stored(toks[:32]))
+        idx.apply_event(1, {"kind": "removed", "block_hashes": h[3:]})
+        idx.flush()
+        assert idx.find_matches(h).scores == {1: 3, 2: 2}
+
+        # Snapshot restores into a differently-sharded indexer.
+        idx2.load_snapshot(idx.dump())
+        assert idx2.find_matches(h).scores == {1: 3, 2: 2}
+    finally:
+        idx.close()
+        idx2.close()
+
+
+def test_sharded_parallel_event_throughput():
+    """Many interleaved stored/removed events across workers stay consistent."""
+    idx = KvIndexerSharded(block_size=BS, num_shards=4)
+    try:
+        for rep in range(20):
+            for w in range(8):
+                toks = list(range(w * 1000, w * 1000 + 64))
+                idx.apply_event(w, ev_stored(toks))
+        idx.flush()
+        for w in range(8):
+            h = compute_block_hashes(list(range(w * 1000, w * 1000 + 64)), BS)
+            assert idx.find_matches(h).scores == {w: 4}
+    finally:
+        idx.close()
+
+
+async def test_prefill_counters_gossip():
+    drt = await DistributedRuntime.detached()
+    try:
+        a = PrefillCountersMultiWorker(drt, "ns", "comp")
+        b = PrefillCountersMultiWorker(drt, "ns", "comp")
+        await a.start()
+        await b.start()
+
+        # Router A routes a 320-token prefill to worker 7.
+        await a.new_prefill("req-1", 7, 320)
+        await asyncio.sleep(0.05)
+        # A does NOT count its own (ActiveSequences already has it); B does.
+        assert a.pending_tokens(7) == 0
+        assert b.pending_tokens(7) == 320
+
+        await a.complete_prefill("req-1", 7)
+        await asyncio.sleep(0.05)
+        assert b.pending_tokens(7) == 0
+
+        await a.stop()
+        await b.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_prefill_counters_in_scheduler_cost():
+    """External pending prefills steer the cost function away from a worker
+    another router just loaded."""
+    seqs = ActiveSequencesMultiWorker(block_size=BS)
+    for w in (1, 2):
+        seqs.ensure_worker(w)
+    sched = KvScheduler(seqs)
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+
+    # No overlap anywhere; worker 1 carries 10 blocks of gossiped prefill.
+    d = sched.select_worker([1, 2], 4, OverlapScores(), external_prefill_tokens={1: 160})
+    assert d.worker == 2
+
+
+async def test_prefill_counters_complete_without_new():
+    """A 'complete' seen without its 'new' (late join) is harmless."""
+    drt = await DistributedRuntime.detached()
+    try:
+        a = PrefillCountersMultiWorker(drt, "ns", "c2")
+        await a.start()
+        await drt.bus.publish(
+            prefill_events_subject("ns", "c2"),
+            json.dumps({"router_id": "other", "kind": "complete", "request_id": "zz", "worker_id": 3}).encode(),
+        )
+        await asyncio.sleep(0.05)
+        assert a.pending_tokens(3) == 0
+        await a.stop()
+    finally:
+        await drt.shutdown()

@@ -1,0 +1,179 @@
+"""Decode-step profiling harness: isolate where step time goes.
+
+Variants measured at the bench config (llama-3.2-1b, b8, ctx1024):
+- full        : current decode (gather attention)
+- kernel      : current decode (pallas paged kernel)
+- no_attn     : attention replaced with identity (isolates weights traffic)
+- no_lm_head  : logits head removed
+- matmul_only : pure streamed-weights matmul chain (HBM bandwidth ceiling)
+
+Prints ms/step + achieved HBM GB/s per variant.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+
+
+def timeit(fn, *args, iters=50, donate=()):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # re-fetch donated args each time is wrong; instead loop with carried outputs when donating
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
+    B = int(os.environ.get("BENCH_BATCH", "8"))
+    ctx = int(os.environ.get("BENCH_CTX", "1024"))
+    cfg = get_config(model).replace(max_seq_len=2048)
+    num_blocks = B * (ctx // cfg.block_size + 4) + 8
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
+
+    needed = (ctx + 64) // cfg.block_size
+    width = min((needed + 15) // 16 * 16, cfg.max_seq_len // cfg.block_size)
+    tables = np.zeros((B, width), dtype=np.int32)
+    for i in range(B):
+        base = 1 + i * (ctx // cfg.block_size)
+        tables[i, :needed] = (np.arange(needed) + base) % (num_blocks - 1) + 1
+    tables = jnp.asarray(tables)
+    active = jnp.ones((B,), dtype=bool)
+    toks = jnp.zeros((B,), dtype=jnp.int32)
+    pos = jnp.full((B,), ctx, dtype=jnp.int32)
+
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    kv_read_bytes = 2 * cfg.num_layers * ctx * cfg.num_kv_heads * cfg.head_dim * 2 * B
+    print(f"params: {param_bytes/1e9:.3f} GB   kv-read/step: {kv_read_bytes/1e9:.3f} GB  width={width} blocks")
+
+    results = {}
+
+    # --- full decode (gather) ---
+    for name, impl in (("gather", "gather"), ("kernel", "paged_kernel")):
+        c = cfg.replace(attention_impl=impl)
+        if impl == "paged_kernel" and (c.kv_size % 128 or c.block_size % 8):
+            continue
+        step = jax.jit(
+            lambda p, k, v, t, po: llama.decode(p, c, k, v, t, po, tables, active),
+            donate_argnums=(1, 2),
+        )
+        k, v = jnp.copy(cache.k), jnp.copy(cache.v)
+        logits, k, v = step(params, k, v, toks, pos)
+        jax.block_until_ready(logits)
+        n = 50
+        t0 = time.perf_counter()
+        for _ in range(n):
+            logits, k, v = step(params, k, v, toks, pos)
+        jax.block_until_ready(logits)
+        ms = (time.perf_counter() - t0) / n * 1000
+        results[name] = ms
+        cost = step.lower(params, k, v, toks, pos).compile().cost_analysis()
+        ba = cost.get("bytes accessed", 0) if cost else 0
+        print(f"{name:12s}: {ms:7.3f} ms  ({(param_bytes+kv_read_bytes)/ms*1e-6:7.1f} GB/s useful)  bytes_accessed={ba/1e9:.2f} GB")
+
+    # --- no attention: isolate weight streaming ---
+    def decode_no_attn(p, t):
+        h = p["embed"].at[t].get(mode="clip")
+
+        def layer_fn(carry, lp):
+            h = carry
+            x = llama.rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            q = x @ lp["wq"]
+            kk = x @ lp["wk"]
+            vv = x @ lp["wv"]
+            attn = q + jnp.concatenate([kk, vv, kk, vv], axis=-1) * 0  # keep shapes
+            h = h + attn @ lp["wo"]
+            x = llama.rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+            return h, None
+
+        h, _ = lax.scan(layer_fn, h, p["layers"])
+        h = llama.rms_norm(h, p["final_norm"], cfg.rms_norm_eps)
+        return (h @ p["embed"].T).astype(jnp.float32)
+
+    f = jax.jit(decode_no_attn)
+    ms = timeit(f, params, toks)
+    results["no_attn"] = ms
+    print(f"{'no_attn':12s}: {ms:7.3f} ms  ({param_bytes/ms*1e-6:7.1f} GB/s weights)")
+
+    # --- no lm_head ---
+    def decode_no_head(p, t):
+        h = p["embed"].at[t].get(mode="clip")
+
+        def layer_fn(carry, lp):
+            h = carry
+            x = llama.rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            q = x @ lp["wq"]
+            kk = x @ lp["wk"]
+            vv = x @ lp["wv"]
+            attn = q + jnp.concatenate([kk, vv, kk, vv], axis=-1) * 0
+            h = h + attn @ lp["wo"]
+            x = llama.rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+            return h, None
+
+        h, _ = lax.scan(layer_fn, h, p["layers"])
+        return h
+
+    f = jax.jit(decode_no_head)
+    ms = timeit(f, params, toks)
+    results["no_head"] = ms
+    print(f"{'no_head':12s}: {ms:7.3f} ms")
+
+    # --- unrolled layers (no scan) ---
+    def decode_unrolled(p, t):
+        h = p["embed"].at[t].get(mode="clip")
+        for l in range(cfg.num_layers):
+            lp = {k2: v2[l] for k2, v2 in p["layers"].items()}
+            x = llama.rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            q = x @ lp["wq"]
+            kk = x @ lp["wk"]
+            vv = x @ lp["wv"]
+            attn = q + jnp.concatenate([kk, vv, kk, vv], axis=-1) * 0
+            h = h + attn @ lp["wo"]
+            x = llama.rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        h = llama.rms_norm(h, p["final_norm"], cfg.rms_norm_eps)
+        return (h @ p["embed"].T).astype(jnp.float32)
+
+    f = jax.jit(decode_unrolled)
+    ms = timeit(f, params, toks)
+    results["unrolled_noattn"] = ms
+    print(f"{'unrl_noattn':12s}: {ms:7.3f} ms  ({param_bytes/ms*1e-6:7.1f} GB/s weights)")
+
+    # --- pure matmul chain: practical bandwidth ceiling ---
+    mats = [jax.random.normal(jax.random.PRNGKey(i), (2048, 8192), dtype=jnp.bfloat16) for i in range(16 * 3)]
+
+    def chain(x, mats):
+        for i, m in enumerate(mats):
+            if i % 2 == 0:
+                x = x @ m
+            else:
+                x = x @ m.T
+        return x
+
+    x0 = jnp.ones((B, 2048), dtype=jnp.bfloat16)
+    f = jax.jit(chain)
+    ms = timeit(f, x0, mats)
+    mat_bytes = sum(m.size * 2 for m in mats)
+    results["matmul_chain"] = ms
+    print(f"{'matmul':12s}: {ms:7.3f} ms  ({mat_bytes/ms*1e-6:7.1f} GB/s  {mat_bytes/1e9:.2f} GB)")
+
+
+if __name__ == "__main__":
+    main()
