@@ -90,32 +90,28 @@ def pick_coordinator_port(host: Optional[str] = None) -> str:
 
 
 async def rendezvous(drt, group: str, num_processes: int, *, timeout_s: float = 60.0) -> MultiHostConfig:
-    """Store-based coordinator election + dense process-id assignment.
+    """Store-based dense process-id assignment + coordinator publication.
 
-    The first process to create ``multihost/{group}/coordinator`` becomes
-    process 0 and publishes its address; every process (leader included)
-    claims a unique id by create-only puts on ``multihost/{group}/rank/{i}``.
+    Rank assignment happens FIRST (create-only puts on
+    ``multihost/{group}/rank/{i}``); only the process that actually won rank
+    0 then publishes its coordinator address, and every other rank polls the
+    key *after* assignment. Publishing before/independently of rank
+    assignment is racy: a process could win the coordinator key but lose
+    rank 0, leaving the group pointed at an address where no coordinator
+    service will ever listen.
     """
     import asyncio
     import time
 
     from dynamo_tpu.runtime.transports.kvstore import KeyExists
 
-    coord_key = f"{COORD_PREFIX}/{group}/coordinator"
-    addr = pick_coordinator_port()
-    try:
-        await drt.store.put(coord_key, addr.encode(), create_only=True)
-        coordinator = addr
-    except KeyExists:
-        entry = await drt.store.get(coord_key)
-        coordinator = entry.value.decode()
-
     process_id = None
     deadline = time.monotonic() + timeout_s
+    marker = f"{socket.gethostname()}:{os.getpid()}"  # opaque claim payload
     while process_id is None:
         for i in range(num_processes):
             try:
-                await drt.store.put(f"{COORD_PREFIX}/{group}/rank/{i}", addr.encode(), create_only=True)
+                await drt.store.put(f"{COORD_PREFIX}/{group}/rank/{i}", marker.encode(), create_only=True)
                 process_id = i
                 break
             except KeyExists:
@@ -125,11 +121,20 @@ async def rendezvous(drt, group: str, num_processes: int, *, timeout_s: float = 
                 raise TimeoutError(f"no free rank among {num_processes} for group {group}")
             await asyncio.sleep(0.1)
 
-    # The coordinator address must belong to rank 0: if we won rank 0 but a
-    # different process won the coordinator key (race), re-point it at us.
-    if process_id == 0 and coordinator != addr:
-        await drt.store.put(coord_key, addr.encode())
-        coordinator = addr
+    coord_key = f"{COORD_PREFIX}/{group}/coordinator"
+    if process_id == 0:
+        coordinator = pick_coordinator_port()
+        await drt.store.put(coord_key, coordinator.encode())
+    else:
+        coordinator = None
+        while coordinator is None:
+            entry = await drt.store.get(coord_key)
+            if entry is not None:
+                coordinator = entry.value.decode()
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rank 0 never published a coordinator for group {group}")
+            await asyncio.sleep(0.1)
 
     return MultiHostConfig(num_processes=num_processes, process_id=process_id, coordinator=coordinator)
 

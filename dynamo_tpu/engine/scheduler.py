@@ -353,7 +353,10 @@ class Scheduler:
         last token) recompute their KV, then decode continues — no sampling
         at the end of a resume."""
         bs = self.mc.block_size
-        if seq.state == SeqState.WAITING and seq.prefilled is not None:
+        # Inject only on first admission: a preempted decode-role sequence
+        # (resume_tokens set) must recompute, not re-inject — re-injection
+        # would duplicate first_token and leave generated-token KV absent.
+        if seq.state == SeqState.WAITING and seq.prefilled is not None and seq.resume_tokens is None:
             return self._inject_prefilled(seq, outputs)
         resuming = seq.resume_tokens is not None
         pf_tokens = seq.resume_tokens if resuming else seq.prompt
@@ -612,6 +615,7 @@ class Scheduler:
         seq.first_token_ts = time.monotonic()
         self.running.append(seq)
         self._append_token(seq, int(data["first_token"]), outputs)
+        seq.prefilled = None  # consumed — a later preemption resumes via recompute
         return True
 
     def take_export(self, request_id: str):

@@ -121,6 +121,7 @@ class KvExportService:
         self.engine = engine
         self.subject = kvx_subject(instance)
         self._task: Optional[asyncio.Task] = None
+        self._reap_tasks: set = set()
 
     async def start(self) -> None:
         _LOCAL_EXPORTERS[self.subject] = self
@@ -199,7 +200,12 @@ class KvExportService:
                 plane.release_offer(rid)
                 await ack_sub.unsubscribe()
 
-        asyncio.get_running_loop().create_task(reap())
+        # Keep a strong reference: the loop holds only weak refs to tasks, so
+        # an un-referenced reap task can be GC'd mid-await, leaking the
+        # offered device buffers and the ack subscription.
+        task = asyncio.get_running_loop().create_task(reap())
+        self._reap_tasks.add(task)
+        task.add_done_callback(self._reap_tasks.discard)
 
     async def stop(self) -> None:
         _LOCAL_EXPORTERS.pop(self.subject, None)

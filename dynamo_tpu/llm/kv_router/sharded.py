@@ -53,6 +53,9 @@ class _Shard:
                 return
             kind, worker, payload = item
             try:
+                if kind == "flush":
+                    payload.set()  # all prior items fully applied (FIFO queue)
+                    continue
                 with self.lock:
                     if kind == "stored":
                         self.tree.apply_stored(worker, payload[0], payload[1])
@@ -132,15 +135,23 @@ class KvIndexerSharded:
 
     # --- maintenance --------------------------------------------------------
     def flush(self, timeout: float = 10.0) -> None:
-        """Block until every shard has drained its queue (quiesce point)."""
+        """Block until every event enqueued before this call has been fully
+        *applied* (quiesce point). Queue emptiness is not enough — the
+        applier pops an item before applying it, so an empty queue can
+        coexist with an event mid-apply; a per-shard sentinel processed
+        in FIFO order cannot."""
         import time
 
         deadline = time.monotonic() + timeout
+        fences = []
         for shard in self.shards:
-            while not shard.queue.empty():
-                if time.monotonic() > deadline:
-                    raise TimeoutError("shard queues did not drain")
-                time.sleep(0.001)
+            ev = threading.Event()
+            shard.queue.put(("flush", None, ev))
+            fences.append(ev)
+        for ev in fences:
+            # wait(0) returns is_set() — an already-set fence never times out.
+            if not ev.wait(max(deadline - time.monotonic(), 0)):
+                raise TimeoutError("shard queues did not drain")
 
     def size(self) -> int:
         total = 0

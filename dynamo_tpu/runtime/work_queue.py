@@ -13,7 +13,11 @@ Design on this runtime's primitives (no new transport surface):
 - Consumers without a lease get a claim *deadline* instead (``claim_ttl_s``,
   stored in the claim value): a consumer that crashes between claim and ack
   only delays redelivery until the deadline passes — items are never
-  orphaned either way.
+  orphaned either way. The deadline is the *writer's* wall clock read by
+  other hosts, so ``claim_ttl_s`` must be generous relative to inter-host
+  clock skew (default 60 s ≫ NTP skew); a thief re-checks the done marker
+  after winning a stolen claim, so a steal can at worst duplicate live
+  work-in-progress, never re-run completed work.
 - Ack writes ``wq/{name}/done/{seq}`` (unleased — completion survives the
   worker) and drops the claim; fully-acked prefixes are purged from the
   stream opportunistically.
@@ -116,6 +120,7 @@ class WorkQueue:
                     self._cursor = msg.seq + 1
                 continue
             existing = await self.store.get(self._claim_key(msg.seq))
+            stole = existing is not None
             if existing is not None:
                 # Lease-less claims carry a deadline; expired ⇒ the claimant
                 # died between claim and ack — steal it. (Delete + create_only
@@ -135,6 +140,17 @@ class WorkQueue:
                 )
             except KeyExists:
                 advance = False
+                continue
+            # On a steal, re-check done AFTER winning the claim: the previous
+            # claimant may have acked between our done-check and the
+            # delete/re-claim above — processing again would duplicate work.
+            # (Claim stealing compares a wall-clock deadline written by another
+            # host; claim_ttl_s must be generous relative to expected clock
+            # skew — see class docstring.) Fresh claims skip the round-trip.
+            if stole and await self.store.get(self._done_key(msg.seq)) is not None:
+                await self.store.delete(self._claim_key(msg.seq))
+                if advance:
+                    self._cursor = msg.seq + 1
                 continue
             return QueueItem(seq=msg.seq, data=msg.data, _queue=self)
         return None
