@@ -216,6 +216,12 @@ class KvExportService:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # Cancel pending reaps so offered buffers / ack subscriptions don't
+        # outlive the service by the reap TTL (their finally blocks release).
+        for task in list(self._reap_tasks):
+            task.cancel()
+        if self._reap_tasks:
+            await asyncio.gather(*self._reap_tasks, return_exceptions=True)
 
 
 async def pull_kv_blocks(drt, instance: Instance, request_id: str) -> List[Tuple[np.ndarray, np.ndarray]]:

@@ -50,6 +50,12 @@ class _Shard:
         while True:
             item = self.queue.get()
             if item is _STOP:
+                # Release any flush fences enqueued behind the stop marker so
+                # a flush() racing close() returns instead of timing out.
+                while not self.queue.empty():
+                    trailing = self.queue.get_nowait()
+                    if trailing is not _STOP and trailing[0] == "flush":
+                        trailing[2].set()
                 return
             kind, worker, payload = item
             try:
@@ -148,10 +154,12 @@ class KvIndexerSharded:
             ev = threading.Event()
             shard.queue.put(("flush", None, ev))
             fences.append(ev)
-        for ev in fences:
-            # wait(0) returns is_set() — an already-set fence never times out.
-            if not ev.wait(max(deadline - time.monotonic(), 0)):
-                raise TimeoutError("shard queues did not drain")
+        for shard, ev in zip(self.shards, fences):
+            while not ev.wait(0.05):
+                if not shard.thread.is_alive():
+                    break  # shard closed: applier gone, nothing in flight
+                if time.monotonic() > deadline:
+                    raise TimeoutError("shard queues did not drain")
 
     def size(self) -> int:
         total = 0

@@ -16,8 +16,9 @@ Design on this runtime's primitives (no new transport surface):
   orphaned either way. The deadline is the *writer's* wall clock read by
   other hosts, so ``claim_ttl_s`` must be generous relative to inter-host
   clock skew (default 60 s ≫ NTP skew); a thief re-checks the done marker
-  after winning a stolen claim, so a steal can at worst duplicate live
-  work-in-progress, never re-run completed work.
+  after winning a stolen claim, which narrows (but cannot fully close,
+  absent CAS) the window where a slow-but-alive claimant's late ack races
+  the steal — the queue is at-least-once, consumers must be idempotent.
 - Ack writes ``wq/{name}/done/{seq}`` (unleased — completion survives the
   worker) and drops the claim; fully-acked prefixes are purged from the
   stream opportunistically.
@@ -143,10 +144,10 @@ class WorkQueue:
                 continue
             # On a steal, re-check done AFTER winning the claim: the previous
             # claimant may have acked between our done-check and the
-            # delete/re-claim above — processing again would duplicate work.
-            # (Claim stealing compares a wall-clock deadline written by another
-            # host; claim_ttl_s must be generous relative to expected clock
-            # skew — see class docstring.) Fresh claims skip the round-trip.
+            # delete/re-claim above. This narrows the duplicate window; it
+            # cannot close it (an alive-but-slow claimant can still ack after
+            # this check — at-least-once semantics, see class docstring).
+            # Fresh claims skip the round-trip.
             if stole and await self.store.get(self._done_key(msg.seq)) is not None:
                 await self.store.delete(self._claim_key(msg.seq))
                 if advance:
