@@ -34,6 +34,21 @@ class ModelConfig:
     # MoE (0 experts = dense).
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # MoE dispatch strategy (ref exposes wide-EP only as engine config,
+    # components/backends/trtllm/utils/trtllm_utils.py:37-39; here it is a
+    # native engine concern):
+    # - "dense":    every expert computes every token (exact, tiny models).
+    # - "ragged":   grouped GEMM via lax.ragged_dot — exact (no token drops),
+    #               per-token FLOPs scale with top-k K, not E. Single-shard /
+    #               tp-sharded meshes.
+    # - "capacity": GShard-style capacity-factor dispatch/combine einsums —
+    #               GSPMD partitions experts over the ``ep`` mesh axis; tokens
+    #               beyond an expert's capacity fall back to their residual.
+    # - "auto":     "ragged"; the engine resolves to "capacity" when ep > 1.
+    moe_dispatch: str = "auto"
+    # Per-expert slot budget for "capacity" dispatch, as a multiple of the
+    # balanced load T*K/E. 2.0 absorbs typical routing imbalance.
+    moe_capacity_factor: float = 2.0
     # Architecture family: "llama" (GQA) or "mla" (DeepSeek-style multi-head
     # latent attention — compressed KV latent cache).
     architecture: str = "llama"
@@ -52,6 +67,10 @@ class ModelConfig:
         if self.attention_impl not in ("auto", "gather", "paged_kernel"):
             raise ValueError(
                 f"attention_impl must be auto|gather|paged_kernel, got {self.attention_impl!r}"
+            )
+        if self.moe_dispatch not in ("auto", "dense", "ragged", "capacity"):
+            raise ValueError(
+                f"moe_dispatch must be auto|dense|ragged|capacity, got {self.moe_dispatch!r}"
             )
 
     @property
@@ -264,3 +283,16 @@ def get_config(name: str) -> ModelConfig:
     if name in PRESETS:
         return PRESETS[name]
     raise KeyError(f"unknown model preset: {name} (have {sorted(PRESETS)})")
+
+
+def resolve_moe_dispatch(config: ModelConfig, ep: int) -> ModelConfig:
+    """Resolve "auto" MoE dispatch against the actual expert-parallel degree.
+
+    Called by every entry point that knows the mesh (Scheduler, pipelined
+    decode, profilers). Wide-EP meshes need "capacity" (its einsum expert
+    axis partitions over ``ep``); single-shard/tp meshes use the exact
+    "ragged" grouped GEMM. Direct model calls that never see a mesh keep the
+    "auto"→"ragged" default in ``_mlp``."""
+    if config.num_experts and config.moe_dispatch == "auto":
+        return config.replace(moe_dispatch="capacity" if ep > 1 else "ragged")
+    return config

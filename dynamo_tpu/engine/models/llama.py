@@ -105,28 +105,143 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _mlp(x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig) -> jax.Array:
-    """Feed-forward block: dense SwiGLU, or MoE when config.num_experts > 0.
-
-    MoE uses dense dispatch (every expert computes every token, combined by
-    router weights) — simple and correct under jit; expert tensors shard over
-    the ``ep`` mesh axis so GSPMD reduces partial expert outputs with one
-    psum (wide-EP sparse dispatch is the optimization path). The reference
-    only *configures* EP in its engines (SURVEY.md §2e); here it is native.
-    """
-    if config.num_experts == 0:
-        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-    T = x.shape[0]
-    E, K = config.num_experts, config.num_experts_per_tok
+def _route(x: jax.Array, lp: Dict[str, jax.Array], K: int):
+    """Top-k routing: (weights [T,K] f32 softmax over the chosen experts,
+    expert ids [T,K] i32)."""
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [T, E]
     top_vals, top_idx = lax.top_k(router_logits, K)
-    weights = jax.nn.softmax(top_vals, axis=-1).astype(x.dtype)  # [T, K]
+    return jax.nn.softmax(top_vals, axis=-1), top_idx
+
+
+def _moe_dense(x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig) -> jax.Array:
+    """Every expert computes every token; router weights combine. Exact but
+    compute inflates ×E/K — the tiny-model / debugging fallback."""
+    T = x.shape[0]
+    E, K = config.num_experts, config.num_experts_per_tok
+    weights, top_idx = _route(x, lp, K)
+    weights = weights.astype(x.dtype)
     combine = jnp.zeros((T, E), dtype=x.dtype).at[jnp.arange(T)[:, None], top_idx].set(weights)
     g = jnp.einsum("td,edf->tef", x, lp["w_gate"])
     u = jnp.einsum("td,edf->tef", x, lp["w_up"])
     h = jax.nn.silu(g) * u
     out = jnp.einsum("tef,efd->ted", h, lp["w_down"])
     return jnp.einsum("ted,te->td", out, combine)
+
+
+def _moe_ragged(
+    x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig, valid: Optional[jax.Array] = None
+) -> jax.Array:
+    """Sparse dispatch via grouped GEMM (``lax.ragged_dot``): sort the T·K
+    (token, expert) assignments by expert, run one ragged matmul per
+    projection over the expert-contiguous rows, and scatter-add the weighted
+    outputs back. Exact (no token drops) and per-token expert FLOPs scale
+    with K, not E — the MegaBlocks formulation in native XLA. Best on a
+    single shard or tp-sharded weights (the group axis cannot be partitioned
+    over ``ep``; use "capacity" dispatch there).
+
+    ``valid`` masks padded rows (inactive decode lanes / prefill padding):
+    they are folded into expert 0's group (finite compute, bounded by bucket
+    padding) and combined with weight 0."""
+    T = x.shape[0]
+    E, K = config.num_experts, config.num_experts_per_tok
+    weights, top_idx = _route(x, lp, K)
+    flat_e = top_idx.reshape(-1)  # [T*K]
+    wflat = weights.reshape(-1)
+    if valid is not None:
+        vflat = jnp.repeat(valid, K)
+        flat_e = jnp.where(vflat, flat_e, 0)
+        wflat = jnp.where(vflat, wflat, 0.0)
+    order = jnp.argsort(flat_e)  # stable: expert-major, token order within
+    tok = order // K  # source token per sorted row
+    xs = x[tok]  # [T*K, D]
+    group_sizes = jnp.bincount(flat_e, length=E)  # [E]
+    g = lax.ragged_dot(xs, lp["w_gate"], group_sizes)
+    u = lax.ragged_dot(xs, lp["w_up"], group_sizes)
+    h = jax.nn.silu(g) * u
+    y = lax.ragged_dot(h, lp["w_down"], group_sizes)  # [T*K, D]
+    w_sorted = wflat[order].astype(x.dtype)
+    return jnp.zeros_like(x).at[tok].add(y * w_sorted[:, None])
+
+
+def _moe_capacity(
+    x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig, valid: Optional[jax.Array] = None
+) -> jax.Array:
+    """GShard-style capacity-factor dispatch: each expert owns C static
+    slots (C = T·K/E · capacity_factor); dispatch/combine are one-hot
+    einsums over [E, C, T], so GSPMD partitions the expert axis over the
+    ``ep`` mesh and the FFN hidden dim over ``tp`` with a single psum
+    combine — the wide-EP serving path. Earlier tokens win slots; a token
+    overflowing every chosen expert's capacity contributes only its residual
+    (raise ``moe_capacity_factor`` if drop counters show pressure).
+
+    ``valid`` masks padded rows so inactive decode lanes cannot steal
+    capacity slots from live tokens (they are excluded from the slot count
+    and dispatched nowhere).
+
+    Cost note: the dispatch/combine einsums are O(E·C·T·D) = O(cf·K·T²·D) —
+    quadratic in T. Relative to the expert GEMMs (O(cf·K·T·D·F)) that is
+    ~T/(3F): negligible for decode batches, ~5% at T=2048/F=14336, growing
+    linearly with prefill chunk length — bound the chunk size fed through
+    this path (the scheduler's prefill buckets already do)."""
+    import math
+
+    T = x.shape[0]
+    E, K = config.num_experts, config.num_experts_per_tok
+    C = max(1, min(T, math.ceil(T * K * config.moe_capacity_factor / E)))
+    weights, top_idx = _route(x, lp, K)
+    flat_e = top_idx.reshape(-1)  # [T*K]
+    tok = jnp.arange(T * K, dtype=jnp.int32) // K
+    # Slot of each assignment within its expert's queue (t-major priority).
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # [T*K, E]
+    if valid is not None:
+        # Invalid rows occupy no slots and are never dispatched.
+        onehot = onehot * jnp.repeat(valid, K).astype(jnp.int32)[:, None]
+    slot = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot, flat_e[:, None], axis=1)[:, 0]
+    keep = slot < C
+    if valid is not None:
+        keep = keep & jnp.repeat(valid, K)
+    slot_c = jnp.clip(slot, 0, C - 1)
+    # (e, slot) pairs are unique among kept rows (cumsum), so .add == .set;
+    # dropped rows add 0.
+    disp = jnp.zeros((E, C, T), dtype=x.dtype).at[flat_e, slot_c, tok].add(keep.astype(x.dtype))
+    comb = jnp.zeros((E, C, T), dtype=jnp.float32).at[flat_e, slot_c, tok].add(
+        jnp.where(keep, weights.reshape(-1), 0.0)
+    )
+    xe = jnp.einsum("ect,td->ecd", disp, x)  # gather tokens into slots
+    g = jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"])
+    u = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
+    h = jax.nn.silu(g) * u
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w_down"])
+    return jnp.einsum("ecd,ect->td", ye.astype(jnp.float32), comb).astype(x.dtype)
+
+
+def _mlp(
+    x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig, valid: Optional[jax.Array] = None
+) -> jax.Array:
+    """Feed-forward block: dense SwiGLU, or MoE when config.num_experts > 0.
+
+    MoE dispatch is selected by ``config.moe_dispatch`` (see config.py):
+    "ragged" (exact grouped GEMM, K-scaling FLOPs) by default, "capacity"
+    (GShard einsum dispatch over the ``ep`` axis) for wide-EP meshes,
+    "dense" as the exhaustive fallback. "auto" resolves via
+    ``resolve_moe_dispatch`` wherever the mesh is known (Scheduler,
+    pipelined decode); direct model calls default to "ragged". The reference
+    only *configures* wide-EP in its engines (SURVEY.md §2e,
+    trtllm_utils.py:37); here the dispatch kernel is native.
+
+    ``valid`` marks live rows (decode ``active`` lanes / prefill valid
+    tokens); sparse dispatch excludes dead rows so they cannot consume
+    expert capacity meant for live tokens."""
+    if config.num_experts == 0:
+        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    mode = config.moe_dispatch
+    if mode == "auto":
+        mode = "ragged"
+    if mode == "dense":
+        return _moe_dense(x, lp, config)
+    if mode == "ragged":
+        return _moe_ragged(x, lp, config, valid)
+    return _moe_capacity(x, lp, config, valid)
 
 
 def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, config: ModelConfig) -> jax.Array:
@@ -215,7 +330,7 @@ def prefill(
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + _mlp(x, lp, c)
+        h = h + _mlp(x, lp, c, valid=valid_q)
         return h, (k, v)
 
     h, (k_rows, v_rows) = lax.scan(layer_fn, h, (params["layers"], k_cache, v_cache))
@@ -303,7 +418,7 @@ def embed(
         attn = _attend(q, k, v, mask, c)
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + _mlp(x, lp, c)
+        h = h + _mlp(x, lp, c, valid=valid)
         return h, None
 
     h, _ = lax.scan(layer_fn, h, params["layers"])
@@ -354,6 +469,7 @@ def decode_layer_scan(
     mask: jax.Array,  # [B, ctx] bool — cached prefix only (decode_targets)
     kv_lens: Optional[jax.Array],  # [B] cached tokens per row (kernel path only)
     use_kernel: bool,
+    active: Optional[jax.Array] = None,  # [B] bool — live lanes (MoE dispatch mask)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decode layer body over a stacked layer group. Factored out of
     ``decode`` so pipeline parallelism (pipeline_parallel.py) can run the
@@ -399,7 +515,7 @@ def decode_layer_scan(
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + _mlp(x, lp, c)
+        h = h + _mlp(x, lp, c, valid=active)
         return h, (k, v)
 
     h, (k_rows, v_rows) = lax.scan(layer_fn, h, (layers, k_cache, v_cache))
@@ -461,7 +577,7 @@ def decode(
 
     h, k_rows, v_rows = decode_layer_scan(
         params["layers"], c, k_cache, v_cache, h, positions,
-        block_tables, mask, kv_lens, use_kernel,
+        block_tables, mask, kv_lens, use_kernel, active=active,
     )
     k_new, v_new = scatter_kv_rows(k_cache, v_cache, k_rows, v_rows, tgt_blocks, tgt_offs)
 

@@ -168,7 +168,7 @@ def prefill(
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + _mlp(x, lp, c)
+        h = h + _mlp(x, lp, c, valid=valid_q)
         return h, latent_new
 
     h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))
@@ -222,7 +222,7 @@ def decode(
         )(q_eff, q_rope, latent_full, mask_full)  # [B, H*v]
         h = h + attn @ lp["wo"]
         x2 = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
-        h = h + _mlp(x2, lp, c)
+        h = h + _mlp(x2, lp, c, valid=active)
         return h, latent_row
 
     h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))

@@ -56,7 +56,9 @@ def pipelined_decode(
 
     Same contract as ``llama.decode``: returns (logits [B, V] f32, k_cache,
     v_cache). Requires ``B % num_microbatches == 0`` (default M = pp)."""
-    c = config
+    from dynamo_tpu.engine.config import resolve_moe_dispatch
+
+    c = resolve_moe_dispatch(config, mesh.shape.get("ep", 1))
     pp = mesh.shape["pp"]
     B = tokens.shape[0]
     M = num_microbatches or pp
@@ -110,7 +112,7 @@ def pipelined_decode(
 
             h_out, k_rows, v_rows = decode_layer_scan(
                 layers, c, kc, vc, h_in, poss_i,
-                tables_i, mask, None, use_kernel=False,
+                tables_i, mask, None, use_kernel=False, active=act_i,
             )
             kc, vc = scatter_kv_rows(kc, vc, k_rows, v_rows, tgt_blocks, tgt_offs)
 

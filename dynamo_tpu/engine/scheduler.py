@@ -190,6 +190,10 @@ class Scheduler:
         mesh=None,
         parallel=None,
     ):
+        from dynamo_tpu.engine.config import resolve_moe_dispatch
+
+        ep = parallel.ep if parallel is not None else (mesh.shape.get("ep", 1) if mesh else 1)
+        model_config = resolve_moe_dispatch(model_config, ep)
         self.mc = model_config
         self.sc = scheduler_config or SchedulerConfig()
         self.mesh = mesh
