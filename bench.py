@@ -246,7 +246,7 @@ def child_main() -> None:
         model = os.environ.get("BENCH_MODEL", "llama-3.2-1b")
         batches = [int(b) for b in os.environ.get("BENCH_BATCHES", "8,16,32").split(",")]
         steps = int(os.environ.get("BENCH_STEPS", "256"))
-        window = int(os.environ.get("BENCH_WINDOW", "8"))
+        window = int(os.environ.get("BENCH_WINDOW", "16"))
         ctx_len = int(os.environ.get("BENCH_CTX", "1024"))
         prompt_len = int(os.environ.get("BENCH_PREFILL", "2048"))
     attn = os.environ.get("BENCH_ATTN", "auto")

@@ -51,12 +51,13 @@ def _decode_kernel(
     # scalar prefetch
     tables_ref,  # SMEM [B, W] int32 — block ids per sequence
     lens_ref,  # SMEM [B] int32 — CACHED kv length (current token separate; 0 = inactive)
+    extra_ref,  # SMEM [B] int32 — valid in-register rows (≥1: current + window)
     # inputs
     w_ref,  # VMEM [1, KVH*HD, KVH*G] — block-diagonal queries
     k_hbm,  # ANY  [N, BS, KVH*HD]
     v_hbm,  # ANY  [N, BS, KVH*HD]
-    kcur_ref,  # VMEM [1, 1, KVH*HD] — current token's key (always attended)
-    vcur_ref,  # VMEM [1, 1, KVH*HD]
+    kcur_ref,  # VMEM [1, R, KVH*HD] — in-register rows: current token (+ window)
+    vcur_ref,  # VMEM [1, R, KVH*HD]
     # outputs
     out_ref,  # VMEM [1, KVH*G, KVH*HD]
     # scratch
@@ -153,19 +154,23 @@ def _decode_kernel(
     m, l, acc = lax.fori_loop(0, n_strips, body, (m0, l0, acc0))
 
     if fold_cur:
-        # Fold in the current token (its K/V never round-trips through HBM):
-        # one [rows] score + rank-1 accumulate closes the online softmax.
-        k_cur = kcur_ref[0]  # [1, merged]
+        # Fold the in-register rows (current token + any multi-step window
+        # rows — their K/V never round-trips through HBM): [rows, R] scores
+        # with columns ≥ extra_ref[b] masked, then close the online softmax.
+        k_cur = kcur_ref[0]  # [R, merged]
         v_cur = vcur_ref[0]
+        R = k_cur.shape[0]
         s_cur = lax.dot_general(
             w, k_cur,
             dimension_numbers=(((0,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [rows, 1]
-        m_f = jnp.maximum(m, s_cur)
+        ) * scale  # [rows, R]
+        col = lax.broadcasted_iota(jnp.int32, (rows, R), 1)
+        s_cur = jnp.where(col < extra_ref[b], s_cur, NEG_INF)
+        m_f = jnp.maximum(m, jnp.max(s_cur, axis=1, keepdims=True))
         alpha_f = jnp.exp(m - m_f)
-        p_f = jnp.exp(s_cur - m_f)  # [rows, 1]
-        l = l * alpha_f + p_f
+        p_f = jnp.exp(s_cur - m_f)  # [rows, R]
+        l = l * alpha_f + jnp.sum(p_f, axis=1, keepdims=True)
         acc = acc * alpha_f + lax.dot_general(
             p_f.astype(v_cur.dtype), v_cur,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -184,19 +189,22 @@ def paged_decode_attention(
     block_tables: jax.Array,  # [B, W] int32
     kv_lens: jax.Array,  # [B] int32 — CACHED tokens per row (0 for inactive)
     *,
-    k_cur: Optional[jax.Array] = None,  # [B, KVH, HD] current token's K (attended in-register)
+    k_cur: Optional[jax.Array] = None,  # [B, KVH, HD] or [B, R, KVH, HD] in-register K rows
     v_cur: Optional[jax.Array] = None,
+    extra_valid: Optional[jax.Array] = None,  # [B] i32 — valid in-register rows (default: all R)
     block_size: int,
     interpret: bool = False,
     pages_per_strip: int = 16,
 ) -> jax.Array:
     """Single decode-step attention over the paged KV cache → [B, H, HD].
 
-    ``k_cur``/``v_cur`` carry the token being decoded: it participates in
-    attention from registers (closing the online softmax) instead of being
-    read back from HBM, so callers can defer the cache write to one fused
-    all-layer scatter (llama.scatter_kv_rows). When omitted, rows attend to
-    the cached prefix only."""
+    ``k_cur``/``v_cur`` carry in-register K/V rows that never round-trip
+    through HBM: the token being decoded, and (multi-step windows) the
+    window's earlier tokens — row 0 must be the current token, rows 1..R-1
+    the window rows, with ``extra_valid[b]`` giving the live prefix count.
+    Callers can thus defer the cache write to one fused scatter per window
+    (llama.decode_multi). When omitted, rows attend to the cached prefix
+    only."""
     B, H, HD = q.shape
     N, BS, KVH, _ = k_cache.shape
     G = H // KVH
@@ -214,25 +222,33 @@ def paged_decode_attention(
         # score masked via zero V and the guard below keeps exactness).
         k_cur_m = jnp.zeros((B, 1, merged), dtype=k_cache.dtype)
         v_cur_m = jnp.zeros((B, 1, merged), dtype=v_cache.dtype)
+        extra = jnp.zeros((B,), dtype=jnp.int32)
         fold_cur = False
     else:
-        k_cur_m = k_cur.reshape(B, 1, merged)
-        v_cur_m = v_cur.reshape(B, 1, merged)
+        R = 1 if k_cur.ndim == 3 else k_cur.shape[1]
+        k_cur_m = k_cur.reshape(B, R, merged)
+        v_cur_m = v_cur.reshape(B, R, merged)
+        extra = (
+            jnp.full((B,), R, dtype=jnp.int32)
+            if extra_valid is None
+            else extra_valid.astype(jnp.int32)
+        )
         fold_cur = True
 
     # Minor-dims merge is layout-free; pages DMA as contiguous [BS, KVH*HD].
     k_m = k_cache.reshape(N, BS, merged)
     v_m = v_cache.reshape(N, BS, merged)
 
+    Rm = k_cur_m.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, merged, rows), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, 1, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Rm, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Rm, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, rows, merged), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -249,7 +265,10 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((B, rows, merged), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), w, k_m, v_m, k_cur_m, v_cur_m)
+    )(
+        block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), extra,
+        w, k_m, v_m, k_cur_m, v_cur_m,
+    )
 
     # Extract the block diagonal: out[b, kvh, g, :] = out_m[b, kvh*G+g, kvh*HD:+HD].
     out5 = out_m.reshape(B, KVH, G, KVH, HD)

@@ -147,22 +147,26 @@ def prefill(
     tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)
     tgt_offs = slots % bs
 
-    # Cache read-only in the scan (slices ride xs); the chunk's latent rows
-    # come out as ys and ONE fused scatter writes all layers afterwards — a
-    # scatter inside the carry forces a full cache copy per layer (measured;
-    # see llama.decode_layer_scan).
+    # Cache read-only in the scan; the chunk's latent rows come out as ys and
+    # ONE fused scatter writes all layers afterwards — a scatter inside the
+    # carry forces a full cache copy per layer (measured; see
+    # llama.decode_layer_scan). The gather reads a layer-flat [L*N] view with
+    # layer-offset tables so the scan never slices the cache per layer
+    # (the slice materializes a layer-cache copy per iteration).
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
     prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))
     chunk_q = jnp.arange(T, dtype=jnp.int32)
     chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]
     mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
+    N = k_cache.shape[1]
+    k_flat = k_cache.reshape(c.num_layers * N, bs, 1, latent_width(c))
 
     def layer_fn(h, xs):
-        lp, kl = xs  # kl [N, BS, 1, R] — this layer's latent cache, read-only
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_new = _latent_kv(x, lp, c, positions)  # [T, R]
-        latent_ctx = kl[block_table].reshape(ctx, latent_width(c))
+        latent_ctx = k_flat[block_table + l * N].reshape(ctx, latent_width(c))
         attn = _attend_latent(
             q_eff, q_rope, jnp.concatenate([latent_ctx, latent_new], axis=0), mask, lp, c
         )
@@ -171,7 +175,9 @@ def prefill(
         h = h + _mlp(x, lp, c, valid=valid_q)
         return h, latent_new
 
-    h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    h, latent_rows = lax.scan(
+        layer_fn, h, (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32))
+    )
     L = c.num_layers
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
     k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
@@ -207,15 +213,18 @@ def decode(
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
     mask = key_pos[None, :] < positions[:, None]
     mask_full = jnp.concatenate([mask, jnp.ones((B, 1), dtype=bool)], axis=1)
+    # Layer-flat view: no per-layer cache slice in the scan (see prefill).
+    N = k_cache.shape[1]
+    k_flat = k_cache.reshape(c.num_layers * N, bs, 1, R)
 
     def layer_fn(h, xs):
-        lp, kl = xs  # kl [N, BS, 1, R] — read-only
+        lp, l = xs
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         # dim 0 is the batch here; rope broadcasts per-row positions the same
         # way it broadcasts per-token positions in prefill.
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_row = _latent_kv(x, lp, c, positions)  # [B, R]
-        latent_ctx = kl[block_tables].reshape(B, ctx, R)
+        latent_ctx = k_flat[block_tables + l * N].reshape(B, ctx, R)
         latent_full = jnp.concatenate([latent_ctx, latent_row[:, None]], axis=1)
         attn = jax.vmap(
             lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
@@ -225,7 +234,9 @@ def decode(
         h = h + _mlp(x2, lp, c, valid=active)
         return h, latent_row
 
-    h, latent_rows = lax.scan(layer_fn, h, (params["layers"], k_cache))
+    h, latent_rows = lax.scan(
+        layer_fn, h, (params["layers"], jnp.arange(c.num_layers, dtype=jnp.int32))
+    )
     L = c.num_layers
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
     k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
@@ -251,21 +262,71 @@ def decode_multi(
     num_steps: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Multi-step decode window (see llama.decode_multi): N steps + sampling
-    per dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache)."""
+    per dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache).
+
+    Window-local latent rows: the cache is READ-ONLY for the whole window —
+    per-step latent rows accumulate in a small carry and ONE fused scatter
+    writes them afterwards (a per-step scatter on the carry forces a full
+    latent-cache copy per iteration; see llama.decode_multi)."""
     from dynamo_tpu.engine.sampling import sample_batch
 
+    c = config
+    bs = c.block_size
     B = tokens.shape[0]
+    L = c.num_layers
+    R = latent_width(c)
+    N = k_cache.shape[1]
+    ctx = block_tables.shape[1] * bs
+    k_flat = k_cache.reshape(L * N, bs, 1, R)
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    mask0 = key_pos[None, :] < positions[:, None]  # fixed: cache not written in-window
 
     def body(i, state):
-        toks, poss, kc, out, key = state
-        logits, kc, _ = decode(params, config, kc, v_cache, toks, poss, block_tables, active)
+        toks, lat_win, out, key = state
+        poss = positions + i
+        h = params["embed"].at[toks].get(mode="clip")
+        win_mask = jnp.broadcast_to(
+            (jnp.arange(num_steps, dtype=jnp.int32) < i)[None, :], (B, num_steps)
+        )
+        mask_full = jnp.concatenate([mask0, win_mask, jnp.ones((B, 1), dtype=bool)], axis=1)
+
+        def layer_fn(h, xs):
+            lp, l, lwl = xs  # lwl: [w, B, R] this layer's window latent rows
+            x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+            q_eff, q_rope = _project_q(x, lp, c, poss)
+            latent_row = _latent_kv(x, lp, c, poss)  # [B, R]
+            latent_ctx = k_flat[block_tables + l * N].reshape(B, ctx, R)
+            latent_full = jnp.concatenate(
+                [latent_ctx, jnp.swapaxes(lwl, 0, 1), latent_row[:, None]], axis=1
+            )
+            attn = jax.vmap(
+                lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
+            )(q_eff, q_rope, latent_full, mask_full)
+            h = h + attn @ lp["wo"]
+            x2 = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+            h = h + _mlp(x2, lp, c, valid=active)
+            return h, latent_row
+
+        h, lat_rows = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32), lat_win)
+        )
+        lat_win = lat_win.at[:, i].set(lat_rows)
+        h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+        head = params.get("lm_head")
+        logits = (h @ (head if head is not None else params["embed"].T)).astype(jnp.float32)
         key, sub = jax.random.split(key)
         nxt = sample_batch(logits, temps, top_ks, top_ps, sub).astype(jnp.int32)
         out = out.at[i].set(nxt)
-        return (nxt, poss + 1, kc, out, key)
+        return (nxt, lat_win, out, key)
 
-    out = jnp.zeros((num_steps, B), dtype=jnp.int32)
-    _, _, k_new, out, _ = lax.fori_loop(
-        0, num_steps, body, (tokens, positions, k_cache, out, rng_key)
-    )
+    lat_win0 = jnp.zeros((L, num_steps, B, R), dtype=k_cache.dtype)
+    out0 = jnp.zeros((num_steps, B), dtype=jnp.int32)
+    _, lat_win, out, _ = lax.fori_loop(0, num_steps, body, (tokens, lat_win0, out0, rng_key))
+
+    steps_i = jnp.arange(num_steps, dtype=jnp.int32)
+    slots = jnp.where(active[None, :], positions[None, :] + steps_i[:, None], 0)  # [w, B]
+    tgt_blocks = jnp.where(active[None, :], block_tables[jnp.arange(B)[None, :], slots // bs], 0)
+    tgt_offs = slots % bs
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, num_steps, B))
+    k_new = k_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None], 0].set(lat_win)
     return out, k_new, v_cache
