@@ -58,6 +58,11 @@ class EngineArgs:
     # Sharded serving: a ParallelConfig (engine/sharding.py) with total > 1
     # builds a device mesh and shards params + KV cache over it.
     parallel: Optional[Any] = None
+    # Speculative decoding: a draft model preset/config proposing spec_gamma
+    # tokens per round (greedy batches only; ref SpecDecodeStats surface).
+    draft_model: Optional[str] = None
+    draft_checkpoint_path: Optional[str] = None
+    spec_gamma: int = 4
 
 
 class TpuEngine:
@@ -82,6 +87,7 @@ class TpuEngine:
         args: EngineArgs,
         *,
         params=None,
+        draft_params=None,
         kv_event_sink: Optional[Callable[[KvEvent], None]] = None,
     ) -> "TpuEngine":
         mc = args.model_config or get_config(args.model)
@@ -115,6 +121,21 @@ class TpuEngine:
             ),
             kv_event_sink=kv_event_sink,
         )
+        if args.draft_model:
+            from dynamo_tpu.engine.models import get_module
+
+            dc = get_config(args.draft_model)
+            if draft_params is None:
+                if args.draft_checkpoint_path:
+                    from dynamo_tpu.engine.weights import load_checkpoint
+
+                    draft_params = load_checkpoint(args.draft_checkpoint_path, dc, dtype=dtype)
+                else:
+                    logger.warning("no draft checkpoint: random weights for %s", dc.name)
+                    draft_params = get_module(dc).init_params(
+                        dc, jax.random.PRNGKey(args.seed + 1), dtype=dtype
+                    )
+            engine.scheduler.attach_draft(dc, draft_params, gamma=args.spec_gamma)
         if args.kvbm_host_blocks > 0:
             from dynamo_tpu.llm.block_manager import KvBlockManager
 

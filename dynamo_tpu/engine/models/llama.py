@@ -564,6 +564,122 @@ def _decode_layer_scan_window(
     return h, k_rows, v_rows
 
 
+def chunk_decode(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B, S] per-row token chunks (padded)
+    positions0: jax.Array,  # [B] position of tokens[:, 0]
+    valid: jax.Array,  # [B] valid tokens per row (0 = inactive row)
+    block_tables: jax.Array,  # [B, W]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched multi-token decode: each row consumes up to S tokens in ONE
+    pass and yields the greedy next-token prediction after every consumed
+    position → (argmax tokens [B, S] i32, k_cache, v_cache).
+
+    This is the engine primitive behind batched speculative decoding
+    (spec_decode.py; ref surfaces SpecDecodeStats, _core.pyi:354-427): the
+    target model verifies γ+1-token chunks for the whole batch in one
+    MXU-friendly pass, and the draft model uses the same op to catch up on
+    accepted tokens. KV rows for all S slots are written (stale-ok: rows
+    past a row's accepted prefix are position-masked until the real token
+    at that position overwrites them — write-before-attend, monotone
+    positions)."""
+    c = config
+    bs = c.block_size
+    B, S = tokens.shape
+    L, KVH, HD = c.num_layers, c.num_kv_heads, c.head_dim
+    kvh, G, hd = KVH, c.num_heads // KVH, HD
+    ctx = block_tables.shape[1] * bs
+    scale = hd**-0.5
+    active = valid > 0
+
+    N = k_cache.shape[1]
+    k_flat = k_cache.reshape(L * N, bs, kvh, hd)
+    v_flat = v_cache.reshape(L * N, bs, kvh, hd)
+
+    h = params["embed"].at[tokens].get(mode="clip")  # [B, S, D]
+    positions = positions0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B, S]
+
+    # Prefix mask: cached keys strictly before the chunk. Chunk mask: causal
+    # within the chunk, limited to each row's valid tokens.
+    key_pos = jnp.arange(ctx, dtype=jnp.int32)
+    prefix_mask = key_pos[None, :] < positions0[:, None]  # [B, ctx]
+    s_i = jnp.arange(S, dtype=jnp.int32)
+    chunk_mask = (s_i[None, None, :] <= s_i[None, :, None]) & (
+        s_i[None, None, :] < valid[:, None, None]
+    )  # [B, S_q, S_k]
+
+    def piece(qg, kp, vp, maskp):
+        """qg [B,S,KVH,G,hd]; kp/vp [B,S_k,KVH,hd]; maskp [B,(S_q,)S_k] →
+        online-softmax partials (m, l, acc) with S_q query positions."""
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kp).astype(jnp.float32) * scale
+        if maskp.ndim == 2:
+            m_b = maskp[:, None, None, None, :]
+        else:
+            m_b = maskp[:, None, None, :, :]
+        s = jnp.where(m_b, s, -1e30)
+        m = jnp.max(s, axis=-1)  # [B,KVH,G,S_q]
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("bkgqs,bskd->bkgqd", p.astype(vp.dtype), vp).astype(jnp.float32)
+        return m, l, acc
+
+    def layer_fn(h, xs):
+        lp, l = xs
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, S, c.num_heads, hd)
+        k = (x @ lp["wk"]).reshape(B, S, kvh, hd)
+        v = (x @ lp["wv"]).reshape(B, S, kvh, hd)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+        qg = q.reshape(B, S, kvh, G, hd)
+
+        tables_l = block_tables + l * N
+        k_ctx = k_flat[tables_l].reshape(B, ctx, kvh, hd)
+        v_ctx = v_flat[tables_l].reshape(B, ctx, kvh, hd)
+        m1, l1, acc1 = piece(qg, k_ctx, v_ctx, prefix_mask)
+        m2, l2, acc2 = piece(qg, k, v, chunk_mask)
+        m_t = jnp.maximum(m1, m2)
+        a1 = jnp.exp(m1 - m_t)
+        a2 = jnp.exp(m2 - m_t)
+        l_t = l1 * a1 + l2 * a2
+        acc = acc1 * a1[..., None] + acc2 * a2[..., None]
+        attn = (acc / jnp.maximum(l_t, 1e-30)[..., None]).astype(h.dtype)  # [B,KVH,G,S,hd]
+        attn = jnp.transpose(attn, (0, 3, 1, 2, 4)).reshape(B, S, c.q_size)
+
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        valid_flat = (s_i[None, :] < valid[:, None]).reshape(B * S)
+        mlp_out = _mlp(x.reshape(B * S, -1), lp, c, valid=valid_flat).reshape(B, S, -1)
+        h = h + mlp_out
+        return h, (k, v)
+
+    h, (k_rows, v_rows) = lax.scan(
+        layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+    )
+
+    # Fused scatter of all chunk rows: slot (b, s) → positions0[b]+s when
+    # s < valid[b], else the scratch sink (block 0 of each layer).
+    live = s_i[None, :] < valid[:, None]  # [B, S]
+    slots = jnp.where(live, positions, 0)
+    tgt_blocks = jnp.where(
+        live, jnp.take_along_axis(block_tables, slots // bs, axis=1), 0
+    )  # [B, S]
+    tgt_offs = slots % bs
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, B, S))
+    # k_rows: [L, B, S, KVH, HD]
+    k_new = k_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(k_rows)
+    v_new = v_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(v_rows)
+
+    h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = h @ (head if head is not None else params["embed"].T)  # [B, S, V]
+    next_tokens = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    return next_tokens, k_new, v_new
+
+
 def embed(
     params: Params,
     config: ModelConfig,

@@ -111,6 +111,9 @@ class Sequence:
     # happens at the end of a resume prefill).
     resume_tokens: Optional[List[int]] = None
     preemptions: int = 0
+    # Speculative decoding: positions coherently materialized in the DRAFT
+    # cache (the draft mirrors the target's block tables; see _decode_spec).
+    d_n: int = 0
 
     @property
     def all_ids(self) -> List[int]:
@@ -165,6 +168,9 @@ class ForwardPassMetrics:
     kv_active_blocks: int = 0
     prefill_tokens_in_flight: int = 0
     request_total: int = 0
+    # Speculative decoding acceptance accounting (SpecDecodeStats.to_dict(),
+    # None when no draft model is attached) — ref: _core.pyi:354-427.
+    spec_decode: Optional[dict] = None
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -251,12 +257,68 @@ class Scheduler:
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)
+        self.dtype = dtype
+        # Speculative decoding (attach_draft): draft model + stats.
+        self.draft_params = None
+        self.draft_cfg = None
+        self.draft_cache = None
+        self.spec_gamma = 0
+        self.spec_stats = None
         self._supports_multi_step = hasattr(model, "decode_multi")
         if self._supports_multi_step:
             self._decode_multi_jit = jax.jit(
                 lambda p, k, v, t, pos, bt, act, te, tk, tp, key: model.decode_multi(
                     p, self.mc, k, v, t, pos, bt, act, te, tk, tp, key,
                     self.sc.num_scheduler_steps,
+                ),
+                donate_argnums=(1, 2),
+            )
+
+    def attach_draft(self, draft_config: ModelConfig, draft_params, *, gamma: int = 4) -> None:
+        """Enable batched speculative decoding: the draft model proposes γ
+        tokens per round and the target verifies them in one chunk pass
+        (llama.chunk_decode). The draft's paged cache mirrors the target's
+        block tables, so allocation/preemption/prefix logic is shared.
+        Ref: the reference surfaces engine speculation via SpecDecodeStats
+        (_core.pyi:354-427); here the machinery is native."""
+        from dynamo_tpu.engine.spec_decode import SpecDecodeStats
+
+        if draft_config.block_size != self.mc.block_size:
+            raise ValueError("draft and target must share block_size")
+        if draft_config.vocab_size != self.mc.vocab_size:
+            raise ValueError("draft and target must share the vocabulary")
+        if draft_config.architecture != "llama" or self.mc.architecture != "llama":
+            raise ValueError("spec decode needs llama-family draft AND target for now")
+        if self.mesh is not None:
+            raise ValueError(
+                "spec decode with sharded serving is not supported yet: draft "
+                "params/cache would need the mesh shardings the target uses"
+            )
+        self.draft_cfg = draft_config
+        self.draft_params = draft_params
+        self.spec_gamma = gamma
+        self.spec_stats = SpecDecodeStats()
+        self.draft_cache = KvCacheArrays.create(draft_config, self.sc.num_blocks, dtype=self.dtype)
+        dc = draft_config
+        self._d_prefill_jit = jax.jit(
+            lambda p, k, v, t, vl, cl, bt: llama.prefill(p, dc, k, v, t, vl, cl, bt),
+            donate_argnums=(1, 2),
+        )
+        self._d_chunk_jit = jax.jit(
+            lambda p, k, v, t, pos, val, bt: llama.chunk_decode(p, dc, k, v, t, pos, val, bt),
+            donate_argnums=(1, 2),
+        )
+        self._t_chunk_jit = jax.jit(
+            lambda p, k, v, t, pos, val, bt: llama.chunk_decode(p, self.mc, k, v, t, pos, val, bt),
+            donate_argnums=(1, 2),
+        )
+        if gamma > 1:
+            # On-device greedy window for proposals 2..γ: one dispatch + one
+            # sync instead of γ-1 round-trips (the host-dispatch overhead
+            # speculation exists to amortize).
+            self._d_multi_jit = jax.jit(
+                lambda p, k, v, t, pos, bt, act, te, tk, tp, key: llama.decode_multi(
+                    p, dc, k, v, t, pos, bt, act, te, tk, tp, key, gamma - 1
                 ),
                 donate_argnums=(1, 2),
             )
@@ -308,6 +370,7 @@ class Scheduler:
             kv_active_blocks=a.num_active,
             prefill_tokens_in_flight=sum(len(s.prompt) - s.num_computed for s in self.waiting),
             request_total=self.request_total,
+            spec_decode=self.spec_stats.to_dict() if self.spec_stats else None,
         )
 
     # --- step loop core (runs in worker thread) -----------------------------
@@ -420,6 +483,7 @@ class Scheduler:
                 0.7 * self._prefill_tok_s + 0.3 * rate
             )
         seq.num_computed += len(tokens)
+        self._draft_catchup_prefill(seq, pf_tokens)
 
         if seq.num_computed < len(pf_tokens):
             return False  # more chunks to go
@@ -457,11 +521,51 @@ class Scheduler:
         width = max(4, ((max_used + 15) // 16) * 16) if max_used > 4 else 4
         return min(width, self.max_blocks_per_seq)
 
+    def _draft_catchup(self, seq: Sequence, tokens: List[int], upto: int) -> None:
+        """Materialize draft KV for positions seq.d_n..upto-1 (prefill-style
+        chunks over ``tokens``). Used to mirror prompt prefill, to absorb
+        remotely-prefilled prompts, and to re-sync rows whose draft lag
+        outgrew the spec chunk width (e.g. after stretches of non-spec
+        decode in mixed batches)."""
+        if self.draft_params is None:
+            return
+        while seq.d_n < upto:
+            start = seq.d_n
+            chunk = min(upto - start, self.sc.max_prefill_chunk)
+            bucket = next_bucket(chunk, self.sc.prefill_buckets)
+            chunk = min(chunk, bucket)
+            toks = tokens[start : start + chunk]
+            padded = np.zeros((bucket,), dtype=np.int32)
+            padded[: len(toks)] = toks
+            _, self.draft_cache.k, self.draft_cache.v = self._d_prefill_jit(
+                self.draft_params, self.draft_cache.k, self.draft_cache.v,
+                jnp.asarray(padded), jnp.int32(len(toks)), jnp.int32(start),
+                self._block_table(seq),
+            )
+            seq.d_n += len(toks)
+
+    def _draft_catchup_prefill(self, seq: Sequence, pf_tokens: List[int]) -> None:
+        """Mirror prefill into the draft cache (spec decode). The draft
+        always computes the FULL prompt — target-side prefix-cache hits
+        don't populate draft KV — so it runs from seq.d_n regardless of
+        where the target's chunks started."""
+        self._draft_catchup(seq, pf_tokens, seq.num_computed)
+
     def _decode_step(self) -> List[tuple]:
         outputs: List[tuple] = []
         n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
         batch = self.running[:n]
         bucket = next_bucket(n, self.sc.decode_buckets)
+
+        if (
+            self.draft_params is not None
+            and not any(
+                seq.sampling.temperature != 0.0 or seq.sampling.logits_processors
+                for seq in batch
+            )
+            and self._decode_spec(batch, bucket, outputs)
+        ):
+            return outputs
 
         if (
             self.sc.num_scheduler_steps > 1
@@ -592,6 +696,110 @@ class Scheduler:
                 self._append_token(seq, int(sampled[s, i]), outputs)
         return True
 
+    def _decode_spec(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
+        """One speculative round for the whole batch: the draft catches up on
+        any unconsumed confirmed tokens and proposes γ tokens (one chunk pass
+        + γ-1 single steps), the target verifies [last ; proposals] in ONE
+        chunk pass, and each row advances by accepted+1 tokens. Greedy rows
+        only (the caller checks). Returns False to fall back to normal
+        decode when blocks/limits don't allow a full window."""
+        gamma = self.spec_gamma
+        S = gamma + 1
+        bs = self.mc.block_size
+        for seq in batch:
+            if seq.total_len + S + 1 > self.mc.max_seq_len:
+                return False
+            need = (seq.total_len + S + 1 + bs - 1) // bs - len(seq.block_ids)
+            if need > 0:
+                try:
+                    seq.block_ids.extend(self.allocator.allocate(need))
+                except OutOfBlocksError:
+                    return False
+            if seq.total_len - seq.d_n > S:
+                # Oversized lag (stretches of non-spec decode in mixed
+                # batches, fallback rounds): absorb it with prefill-style
+                # chunks so the row rejoins speculation instead of latching
+                # the whole batch off spec forever.
+                self._draft_catchup(seq, seq.all_ids, seq.total_len - 1)
+
+        B = bucket
+        width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
+        tables = np.zeros((B, width), dtype=np.int32)
+        d_toks = np.zeros((B, S), dtype=np.int32)
+        d_pos0 = np.zeros((B,), dtype=np.int32)
+        d_valid = np.zeros((B,), dtype=np.int32)
+        for i, seq in enumerate(batch):
+            lag = seq.total_len - seq.d_n  # ≥ 1: the last token is never materialized
+            d_toks[i, :lag] = seq.all_ids[seq.d_n :]
+            d_pos0[i] = seq.d_n
+            d_valid[i] = lag
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+        tables_j = jnp.asarray(tables)
+
+        # Draft: catch-up chunk (first proposal from its last valid position),
+        # then γ-1 single steps.
+        d_preds, self.draft_cache.k, self.draft_cache.v = self._d_chunk_jit(
+            self.draft_params, self.draft_cache.k, self.draft_cache.v,
+            jnp.asarray(d_toks), jnp.asarray(d_pos0), jnp.asarray(d_valid), tables_j,
+        )
+        d_preds_h = np.asarray(d_preds)
+        proposals = np.zeros((B, gamma), dtype=np.int32)
+        cur = np.zeros((B,), dtype=np.int32)
+        poss = np.zeros((B,), dtype=np.int32)
+        act = np.zeros((B,), dtype=bool)
+        for i, seq in enumerate(batch):
+            proposals[i, 0] = d_preds_h[i, d_valid[i] - 1]
+            cur[i] = proposals[i, 0]
+            poss[i] = seq.total_len
+            act[i] = True
+        if gamma > 1:
+            # Proposals 2..γ in ONE on-device greedy window (decode_multi):
+            # one dispatch + one sync instead of γ-1 host round-trips.
+            self._step_counter += 1
+            key = jax.random.fold_in(self._rng, self._step_counter)
+            zeros_f = jnp.zeros((B,), jnp.float32)
+            toks_out, self.draft_cache.k, self.draft_cache.v = self._d_multi_jit(
+                self.draft_params, self.draft_cache.k, self.draft_cache.v,
+                jnp.asarray(cur), jnp.asarray(poss), tables_j, jnp.asarray(act),
+                zeros_f, jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32), key,
+            )
+            proposals[:, 1:] = np.asarray(toks_out).T
+
+        # Target: verify [last_confirmed ; proposals] in one chunk pass.
+        t_toks = np.zeros((B, S), dtype=np.int32)
+        t_pos0 = np.zeros((B,), dtype=np.int32)
+        t_valid = np.zeros((B,), dtype=np.int32)
+        for i, seq in enumerate(batch):
+            t_toks[i, 0] = seq.all_ids[-1]
+            t_toks[i, 1:] = proposals[i]
+            t_pos0[i] = seq.total_len - 1
+            t_valid[i] = S
+        t_preds, self.cache.k, self.cache.v = self._t_chunk_jit(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(t_toks), jnp.asarray(t_pos0), jnp.asarray(t_valid), tables_j,
+        )
+        t_preds_h = np.asarray(t_preds)
+
+        st = self.spec_stats
+        st.num_rounds += 1
+        for i, seq in enumerate(batch):
+            if seq.state != SeqState.RUNNING:
+                continue
+            k = 0
+            while k < gamma and proposals[i, k] == t_preds_h[i, k]:
+                k += 1
+            st.record_round(k, gamma)
+            old_total = seq.total_len
+            for t in list(proposals[i, :k]) + [int(t_preds_h[i, k])]:
+                if seq.state != SeqState.RUNNING:
+                    break  # stop hit mid-chunk; stale KV rows are position-masked
+                self._append_token(seq, int(t), outputs)
+            # Draft-coherent prefix: catch-up reached old_total-1; proposal
+            # inputs covered positions old_total..old_total+γ-2, of which the
+            # first min(k, γ-1) carry accepted (confirmed) tokens.
+            seq.d_n = old_total + min(k, gamma - 1)
+        return True
+
     # --- disaggregation support ---------------------------------------------
     def _inject_prefilled(self, seq: Sequence, outputs: List[tuple]) -> bool:
         """Decode-role admission: KV arrived from a prefill worker — scatter
@@ -612,6 +820,9 @@ class Scheduler:
             for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
                 scatter_blocks(self.cache, bid, k_np, v_np)
         seq.num_computed = len(seq.prompt)
+        # Spec decode: the draft cache has nothing for remotely-prefilled KV —
+        # compute the draft's own prompt KV before the row joins spec rounds.
+        self._draft_catchup_prefill(seq, seq.prompt)
         if self.sc.enable_prefix_caching:
             seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
             self._register_full_blocks(seq)
@@ -716,6 +927,7 @@ class Scheduler:
         victim.block_hashes = []
         victim.num_cached_blocks = 0
         victim.num_computed = 0
+        victim.d_n = 0  # draft cache rows are gone with the blocks
         # Recompute everything up to (not including) the last token; the
         # last token re-enters through the decode step on resume.
         victim.resume_tokens = list(victim.all_ids[:-1])

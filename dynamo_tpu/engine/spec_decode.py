@@ -50,6 +50,16 @@ class SpecDecodeStats:
     def acceptance_rate(self) -> float:
         return self.num_accepted_tokens / self.num_draft_tokens if self.num_draft_tokens else 0.0
 
+    def record_round(self, accepted: int, gamma: int) -> None:
+        """Account one speculative round: γ proposed, ``accepted`` agreed."""
+        self.num_draft_tokens += gamma
+        self.num_spec_tokens += gamma
+        self.num_accepted_tokens += accepted
+        while len(self.accepted_per_position) < gamma:
+            self.accepted_per_position.append(0)
+        for i in range(accepted):
+            self.accepted_per_position[i] += 1
+
     def to_dict(self) -> dict:
         return {
             "num_spec_tokens": self.num_spec_tokens,
@@ -186,13 +196,7 @@ class SpecDecoder:
 
             if stats is not None:
                 stats.num_rounds += 1
-                stats.num_draft_tokens += self.gamma
-                stats.num_spec_tokens += self.gamma
-                stats.num_accepted_tokens += k
-                while len(stats.accepted_per_position) < self.gamma:
-                    stats.accepted_per_position.append(0)
-                for i in range(k):
-                    stats.accepted_per_position[i] += 1
+                stats.record_round(k, self.gamma)
 
             # Emit accepted + bonus, honoring eos/max_tokens.
             for t in accepted:

@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--tokenizer", default=None)
+    p.add_argument("--draft-model", default=None,
+                   help="draft model preset for speculative decoding (greedy batches)")
+    p.add_argument("--draft-checkpoint", default=None)
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="speculative tokens proposed per round")
     p.add_argument("--kvbm-host-blocks", type=int, default=0)
     p.add_argument("--kvbm-disk-dir", default=None)
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
@@ -99,6 +104,9 @@ async def amain(args) -> None:
                 kvbm_disk_blocks=args.kvbm_disk_blocks,
                 scheduler=SchedulerConfig(num_blocks=args.num_blocks, max_running=args.max_running),
                 parallel=parallel,
+                draft_model=args.draft_model,
+                draft_checkpoint_path=args.draft_checkpoint,
+                spec_gamma=args.spec_gamma,
             )
         )
 

@@ -1,0 +1,170 @@
+"""Speculative decoding wired into the serving Scheduler (VERDICT r2 #4):
+identical greedy output with and without a draft model, acceptance stats
+published through ForwardPassMetrics, prefix-cache + preemption interplay.
+Ref surface: SpecDecodeStats in ForwardPassMetrics (_core.pyi:354-427)."""
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+
+CFG = get_config("tiny")
+
+
+def make_sched(params, draft=None, gamma=4, **kw):
+    defaults = dict(num_blocks=64, prefill_buckets=[16, 32, 64], decode_buckets=[1, 2, 4])
+    defaults.update(kw)
+    s = Scheduler(CFG, params, SchedulerConfig(**defaults), dtype=jnp.float32)
+    if draft is not None:
+        s.attach_draft(CFG, draft, gamma=gamma)
+    return s
+
+
+def drain(s, cap=500):
+    produced = {}
+    for _ in range(cap):
+        if not s.has_work():
+            break
+        for seq, out in s.step():
+            produced.setdefault(seq.request_id, []).append(out)
+    assert not s.has_work()
+    return {r: [o.token_id for o in outs if o.token_id >= 0] for r, outs in produced.items()}
+
+
+def add(s, rid, prompt, n=20):
+    s.add_request(rid, prompt, SamplingParams(temperature=0.0), StopConditions(max_tokens=n))
+
+
+def test_self_speculation_identical_and_full_acceptance():
+    """Draft == target ⇒ every proposal accepted; output identical to the
+    plain scheduler and each round advances γ+1 tokens."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    base = make_sched(params)
+    for i in range(2):
+        add(base, f"r{i}", list(range(3 + i, 19 + i)))
+    ref = drain(base)
+
+    spec = make_sched(params, draft=params, gamma=4)
+    for i in range(2):
+        add(spec, f"r{i}", list(range(3 + i, 19 + i)))
+    out = drain(spec)
+    assert out == ref, (out, ref)
+
+    st = spec.spec_stats
+    assert st.num_rounds > 0
+    assert st.acceptance_rate == 1.0, st.to_dict()
+    # >1 token materialized per target forward (the whole point).
+    produced = sum(len(v) for v in out.values())
+    assert produced / st.num_rounds > 2.0
+    # Stats flow into the published metrics.
+    m = spec.metrics()
+    assert m.spec_decode["num_accepted_tokens"] == st.num_accepted_tokens
+
+
+def test_disagreeing_draft_still_exact():
+    """A differently-initialized draft mostly disagrees — output must STILL
+    equal the plain scheduler's (speculation is lossless)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    draft = llama.init_params(CFG, jax.random.PRNGKey(42), dtype=jnp.float32)
+    base = make_sched(params)
+    for i in range(2):
+        add(base, f"r{i}", list(range(5 + i, 21 + i)))
+    ref = drain(base)
+
+    spec = make_sched(params, draft=draft, gamma=3)
+    for i in range(2):
+        add(spec, f"r{i}", list(range(5 + i, 21 + i)))
+    out = drain(spec)
+    assert out == ref, (out, ref)
+    assert spec.spec_stats.num_rounds > 0
+
+
+def test_spec_with_prefix_cache_hit():
+    """Second request shares the first's prompt: the target prefix-hits but
+    the draft must recompute its own KV — outputs stay identical."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = list(range(7, 39))  # 2 full blocks
+
+    base = make_sched(params)
+    add(base, "a", prompt, n=12)
+    ref_a = drain(base)["a"]
+    add(base, "b", prompt, n=12)
+    ref_b = drain(base)["b"]
+    assert ref_a == ref_b
+
+    spec = make_sched(params, draft=params, gamma=4)
+    add(spec, "a", prompt, n=12)
+    out_a = drain(spec)["a"]
+    add(spec, "b", prompt, n=12)
+    out_b = drain(spec)["b"]
+    assert out_a == ref_a
+    assert out_b == ref_b
+
+
+def test_spec_mixed_sampling_falls_back_then_recovers():
+    """A batch containing a sampling row skips spec rounds; once the sampled
+    row finishes, the greedy row's accumulated draft lag is absorbed and
+    speculation RESUMES (it must not latch off permanently)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    spec = make_sched(params, draft=params)
+    spec.add_request("g", list(range(3, 19)), SamplingParams(temperature=0.0),
+                     StopConditions(max_tokens=40))
+    spec.add_request("s", list(range(4, 20)), SamplingParams(temperature=0.8),
+                     StopConditions(max_tokens=8))
+    out = drain(spec)
+    assert len(out["g"]) == 40 and len(out["s"]) == 8
+    # The sampled row forced >gamma+1 plain rounds; speculation must still
+    # have run (lag absorbed, rounds recorded) once the batch went greedy.
+    assert spec.spec_stats.num_rounds > 0, spec.spec_stats.to_dict()
+    # And the greedy row matches a plain scheduler end-to-end.
+    base = make_sched(params)
+    base.add_request("g", list(range(3, 19)), SamplingParams(temperature=0.0),
+                     StopConditions(max_tokens=40))
+    assert out["g"] == drain(base)["g"]
+
+
+async def test_engine_e2e_with_draft_model():
+    """The aggregated-worker path: TpuEngine built with draft_model (same
+    seed ⇒ identical models ⇒ full acceptance) serves the same greedy tokens
+    as a plain engine, with >1 accepted token per round in the stats."""
+    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def build(draft):
+        return TpuEngine.build(EngineArgs(
+            model="tiny", dtype="float32",
+            scheduler=SchedulerConfig(num_blocks=64, max_running=8,
+                                      prefill_buckets=[16, 32, 64],
+                                      decode_buckets=[1, 2, 4, 8]),
+            draft_model="tiny" if draft else None, spec_gamma=4,
+        ), params=params, draft_params=params if draft else None)
+
+    async def collect(engine, prompt, n=12):
+        out = []
+        async for frame in engine.generate(
+            {"token_ids": prompt, "sampling_options": {"temperature": 0.0},
+             "stop_conditions": {"max_tokens": n}}, Context()):
+            out.extend(frame["token_ids"])
+        return out
+
+    prompt = list(range(20, 40))
+    plain = build(draft=False)
+    try:
+        ref = await collect(plain, prompt)
+    finally:
+        await plain.stop()
+
+    spec = build(draft=True)
+    try:
+        out = await collect(spec, prompt)
+        st = spec.scheduler.spec_stats
+        assert out == ref, (out, ref)
+        assert st.num_rounds > 0
+        assert st.num_accepted_tokens / st.num_rounds > 1.0, st.to_dict()
+    finally:
+        await spec.stop()
