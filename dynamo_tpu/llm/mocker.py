@@ -1,20 +1,35 @@
 """Mocker engine: full engine emulation with no TPU.
 
 Ref: lib/llm/src/mocker/* (3,226 LoC) — ``MockVllmEngine`` (engine.rs:48)
-simulates prefill/decode timing, KV block allocation with prefix caching, and
-KV events at ``speedup_ratio``; the reference's distributed test suite runs
-whole router/frontend topologies against fleets of these (SURVEY.md §4 — the
-single highest-leverage test asset).
+simulates a batched scheduler with prefill/decode timing, KV block
+allocation with prefix caching, watermark-driven preemption, and KV events,
+all compressed by ``speedup_ratio``; the reference's distributed test suite
+runs whole router/frontend topologies against fleets of these (SURVEY.md §4
+— the single highest-leverage test asset).
 
-This mocker reuses the *real* BlockAllocator + chained hashing, so its KV
-events and prefix-cache hit behavior are bit-identical to the real engine's;
-only the compute is replaced by sleeps.
+This mocker mirrors the real engine's architecture (scheduler.py) rather
+than simulating per-request in isolation:
+
+- ONE batched simulation loop steps all running sequences together; each
+  step's duration comes from a load-dependent timing model —
+  ``decode_ms(batch, active_kv_tokens)`` (bandwidth-bound decode: a base
+  weights-streaming floor plus per-sequence and per-cached-token terms) and
+  ``prefill_ms(chunk_tokens)`` for the chunked prefill admitted alongside —
+  so routers and the planner observe the queueing effects the reference
+  mocker models (mocker/scheduler.rs:240): ITL rises with batch size and
+  with active context length.
+- The *real* ``BlockAllocator`` + chained hashing provide prefix caching
+  and block-granular KV events, bit-identical to the real engine's.
+- Watermark preemption: when block allocation fails mid-decode, the newest
+  running sequence is preempted (blocks released → removed events) and
+  requeued for recompute — the real scheduler's policy.
+- ``speedup_ratio`` compresses simulated time uniformly.
 """
 
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, List, Optional
 
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvEvent, OutOfBlocksError
@@ -34,23 +49,85 @@ class MockEngineArgs:
     num_blocks: int = 512
     max_batch: int = 32
     speedup_ratio: float = 1.0
-    prefill_time_per_token_ms: float = 0.05
-    decode_time_per_token_ms: float = 5.0
+    # Fraction of blocks kept free: allocations that would dip below the
+    # watermark trigger preemption (ref mocker's eviction policy).
     watermark: float = 0.01
+    # Timing model — decode is bandwidth-bound (weights floor + per-seq +
+    # per-active-KV-token), prefill is compute-bound (per-token).
+    itl_base_ms: float = 3.0
+    itl_per_seq_ms: float = 0.05
+    itl_per_kv_token_us: float = 0.05
+    prefill_base_ms: float = 0.5
+    prefill_per_token_us: float = 40.0
+    max_prefill_chunk: int = 2048
+    # Back-compat aliases used by older callers/flags.
+    prefill_time_per_token_ms: Optional[float] = None
+    decode_time_per_token_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.prefill_time_per_token_ms is not None:
+            self.prefill_per_token_us = self.prefill_time_per_token_ms * 1000.0
+        if self.decode_time_per_token_ms is not None:
+            self.itl_base_ms = self.decode_time_per_token_ms
+
+    def decode_ms(self, batch: int, active_kv_tokens: int) -> float:
+        return (
+            self.itl_base_ms
+            + batch * self.itl_per_seq_ms
+            + active_kv_tokens * self.itl_per_kv_token_us / 1000.0
+        )
+
+    def prefill_ms(self, chunk_tokens: int) -> float:
+        return self.prefill_base_ms + chunk_tokens * self.prefill_per_token_us / 1000.0
+
+
+class _Seq:
+    def __init__(self, request_id: str, tokens: List[int], max_tokens: int, context: Context):
+        self.request_id = request_id
+        self.tokens = tokens
+        self.max_tokens = max_tokens
+        self.context = context
+        self.out: asyncio.Queue = asyncio.Queue()
+        self.block_ids: List[int] = []
+        self.hashes = []
+        self.computed = 0  # tokens (re)computed toward prefill_span
+        self.cached_tokens = 0
+        self.generated = 0
+        self.recompute = 0  # generated tokens whose KV must be recomputed (preemption)
+        self.preemptions = 0
+        self.done = False
+
+    @property
+    def total_len(self) -> int:
+        return len(self.tokens) + self.generated
+
+    @property
+    def prefill_span(self) -> int:
+        """Tokens the (re)prefill must cover: the prompt, plus — after a
+        preemption — the generated tokens whose KV was dropped (the real
+        scheduler's recompute-preemption cost)."""
+        return len(self.tokens) + self.recompute
+
+    @property
+    def in_decode(self) -> bool:
+        return self.computed >= self.prefill_span
 
 
 class MockTpuEngine:
-    """AsyncEngine-shaped engine emulator."""
+    """AsyncEngine-shaped engine emulator with a batched scheduler core."""
 
     def __init__(self, args: Optional[MockEngineArgs] = None, *, kv_event_sink: Optional[Callable[[KvEvent], None]] = None):
         self.args = args or MockEngineArgs()
         self._sink = kv_event_sink
         self.allocator = BlockAllocator(self.args.num_blocks, on_event=self._on_event)
-        self._batch = asyncio.Semaphore(self.args.max_batch)
-        self._active = 0
-        self._waiting = 0
+        self.waiting: List[_Seq] = []
+        self.running: List[_Seq] = []
         self.request_total = 0
         self.prefill_tokens_done = 0
+        self.preempt_total = 0
+        self.last_step_ms = 0.0  # most recent simulated step duration
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
 
     def _on_event(self, ev: KvEvent) -> None:
         if self._sink is not None:
@@ -61,59 +138,199 @@ class MockTpuEngine:
 
     # --- AsyncEngine --------------------------------------------------------
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
-        args = self.args
         tokens: List[int] = list(request.get("token_ids") or [])
         stop = request.get("stop_conditions") or {}
         max_tokens = int(stop.get("max_tokens") or 16)
         self.request_total += 1
-        self._waiting += 1
-        async with self._batch:
-            self._waiting -= 1
-            self._active += 1
-            block_ids: List[int] = []
-            try:
-                hashes = compute_block_hashes(tokens, args.block_size)
-                matched = self.allocator.match_prefix(hashes)
-                cached_tokens = len(matched) * args.block_size
-                block_ids = list(matched)
-                needed = (len(tokens) + max_tokens + args.block_size - 1) // args.block_size - len(block_ids)
-                while needed > 0:
-                    try:
-                        block_ids.extend(self.allocator.allocate(needed))
-                        needed = 0
-                    except OutOfBlocksError:
-                        await asyncio.sleep(0.005 / args.speedup_ratio)  # backpressure
-                        if context.is_stopped():
-                            return
+        seq = _Seq(f"mock-{self.request_total}", tokens, max_tokens, context)
+        self.waiting.append(seq)
+        self._ensure_loop()
+        self._wake.set()
+        try:
+            while True:
+                frame = await seq.out.get()
+                if frame is None:
+                    return
+                yield frame
+                if frame.get("finish_reason"):
+                    return
+        finally:
+            seq.done = True
 
-                # Prefill: time proportional to uncached tokens.
-                uncached = max(0, len(tokens) - cached_tokens)
-                await asyncio.sleep(uncached * args.prefill_time_per_token_ms / 1000.0 / args.speedup_ratio)
-                self.prefill_tokens_done += uncached
-                n_full = len(hashes)
-                self.allocator.register_hashes(block_ids[:n_full], hashes)
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(self._sim_loop())
 
-                # Decode: one token per step at the configured ITL.
-                for i in range(max_tokens):
-                    if context.is_stopped():
-                        yield {"token_ids": [], "finish_reason": "cancelled", "index": 0}
+    # --- batched simulation core -------------------------------------------
+    async def _sim_loop(self) -> None:
+        args = self.args
+        while self.waiting or self.running:
+            self._reap_stopped()
+            step_ms = 0.0
+
+            # Admission: one prefill chunk per step (the real scheduler's
+            # decode-first/one-admission policy), bounded by max_batch.
+            # Prefer a mid-chunk sequence (it already holds blocks — leaving
+            # it parked while the head can't allocate is a head-of-line
+            # deadlock); otherwise take the head.
+            if self.waiting and len(self.running) < args.max_batch:
+                seq = next((s for s in self.waiting if s.block_ids), self.waiting[0])
+                chunk = self._admit_chunk(seq)
+                if chunk:
+                    step_ms += args.prefill_ms(chunk)
+                    self.prefill_tokens_done += chunk
+                if seq.in_decode:
+                    # remove() not pop(0): _admit_chunk's allocation may have
+                    # preempted a victim INTO waiting[0] just now.
+                    self.waiting.remove(seq)
+                    self.running.append(seq)
+
+            # Batched decode step: every running sequence produces one token;
+            # latency depends on batch width and total active KV.
+            decoding = [s for s in self.running if s.in_decode]
+            if decoding:
+                active_kv = sum(s.total_len for s in decoding)
+                step_ms += args.decode_ms(len(decoding), active_kv)
+
+            if step_ms == 0.0:
+                # Nothing admissible (block pressure): idle-wait a tick.
+                step_ms = args.itl_base_ms
+
+            self.last_step_ms = step_ms
+            await asyncio.sleep(step_ms / 1000.0 / args.speedup_ratio)
+
+            for s in list(decoding):
+                if s not in self.running:
+                    continue  # preempted mid-step by another row's allocation
+                if s.context.is_stopped():
+                    continue  # reaped next iteration
+                if not self._grow_blocks(s):
+                    continue  # preempted (itself) — no token this step
+                s.generated += 1
+                token = s.tokens[s.generated % len(s.tokens)] if s.tokens else s.generated
+                finish = "length" if s.generated >= s.max_tokens else None
+                s.out.put_nowait({"token_ids": [token], "finish_reason": finish, "index": 0})
+                if finish:
+                    self._finish(s)
+            if not (self.waiting or self.running):
+                # Wait briefly for new arrivals before exiting the loop task.
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=0.2)
+                except asyncio.TimeoutError:
+                    # A request may have landed in the shutdown window (its
+                    # _wake.set() can race the cancelled waiter): only exit
+                    # when there is truly no work.
+                    if not (self.waiting or self.running):
                         return
-                    await asyncio.sleep(args.decode_time_per_token_ms / 1000.0 / args.speedup_ratio)
-                    token = tokens[i % len(tokens)] if tokens else i
-                    finish = "length" if i == max_tokens - 1 else None
-                    yield {"token_ids": [token], "finish_reason": finish, "index": 0}
-            finally:
-                self.allocator.release(block_ids)
-                self._active -= 1
+
+    def _reap_stopped(self) -> None:
+        for s in list(self.running):
+            if s.context.is_stopped() or s.done:
+                if not s.done:
+                    s.out.put_nowait({"token_ids": [], "finish_reason": "cancelled", "index": 0})
+                self._finish(s)
+        for s in list(self.waiting):
+            if s.context.is_stopped() or s.done:
+                self.waiting.remove(s)
+                self.allocator.release(s.block_ids)
+                s.block_ids = []
+                if not s.done:
+                    s.out.put_nowait({"token_ids": [], "finish_reason": "cancelled", "index": 0})
+
+    def _admit_chunk(self, seq: _Seq) -> int:
+        """Advance one prefill chunk; returns simulated chunk tokens (0 when
+        blocked on KV blocks). First touch matches the prefix cache."""
+        args = self.args
+        bs = args.block_size
+        if seq.computed == 0 and not seq.block_ids:
+            seq.hashes = compute_block_hashes(seq.tokens, bs)
+            matched = self.allocator.match_prefix(seq.hashes)
+            if matched and len(matched) * bs >= len(seq.tokens):
+                self.allocator.release([matched[-1]])
+                matched = matched[:-1]
+            seq.block_ids = list(matched)
+            seq.cached_tokens = len(matched) * bs
+            seq.computed = min(seq.cached_tokens, seq.prefill_span)
+            # Cover the full current length (prompt + any generated tokens
+            # being recomputed after preemption) plus the next write slot.
+            needed = (seq.total_len + 1 + bs - 1) // bs - len(seq.block_ids)
+            if needed > 0 and not self._allocate(seq, needed):
+                # Roll back the first touch entirely; retried next step.
+                self.allocator.release(seq.block_ids)
+                seq.block_ids = []
+                seq.computed = 0
+                seq.cached_tokens = 0
+                return 0
+        remaining = seq.prefill_span - seq.computed
+        chunk = min(remaining, args.max_prefill_chunk)
+        seq.computed += chunk
+        if seq.in_decode:
+            n_full = len(seq.hashes)
+            self.allocator.register_hashes(seq.block_ids[:n_full], seq.hashes)
+        return chunk
+
+    def _allocate(self, seq: _Seq, n: int) -> bool:
+        """Allocate n blocks, preempting the newest running sequence when the
+        pool dips below the watermark (ref mocker's eviction policy)."""
+        args = self.args
+        floor = int(args.num_blocks * args.watermark)
+        while True:
+            if self.allocator.num_blocks - self.allocator.num_active - n >= floor:
+                try:
+                    seq.block_ids.extend(self.allocator.allocate(n))
+                    return True
+                except OutOfBlocksError:
+                    pass
+            if not self._preempt_newest(exclude=seq):
+                return False
+
+    def _grow_blocks(self, seq: _Seq) -> bool:
+        bs = self.args.block_size
+        while seq.total_len + 1 > len(seq.block_ids) * bs:
+            if not self._allocate(seq, 1):
+                # Could not grow even after preempting others: preempt SELF.
+                self._preempt(seq)
+                return False
+        return True
+
+    def _preempt_newest(self, exclude: Optional[_Seq] = None) -> bool:
+        candidates = [s for s in self.running if s is not exclude and s.in_decode]
+        if not candidates:
+            return False
+        self._preempt(candidates[-1])
+        return True
+
+    def _preempt(self, seq: _Seq) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        self.allocator.release(seq.block_ids)
+        seq.block_ids = []
+        seq.hashes = []
+        seq.computed = 0
+        seq.cached_tokens = 0
+        seq.recompute = seq.generated  # dropped KV must be recomputed
+        seq.preemptions += 1
+        self.preempt_total += 1
+        self.waiting.insert(0, seq)
+
+    def _finish(self, seq: _Seq) -> None:
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        self.allocator.release(seq.block_ids)
+        seq.block_ids = []
 
     # --- stats --------------------------------------------------------------
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
-            num_running=self._active,
-            num_waiting=self._waiting,
+            num_running=len(self.running),
+            num_waiting=len(self.waiting),
             kv_usage=self.allocator.usage(),
             kv_total_blocks=self.allocator.num_blocks,
             kv_active_blocks=self.allocator.num_active,
+            prefill_tokens_in_flight=sum(len(s.tokens) - s.computed for s in self.waiting),
             request_total=self.request_total,
         )
 
