@@ -12,8 +12,10 @@ TPU-native mapping:
   eviction hook). The reference's ``block_copy.cu`` kernels become jitted XLA
   gather/scatter + ``jax.device_get/put`` DMA (transfer.py).
 - **G3** — local disk: file-per-block spill from G2 eviction.
-- **G4** — remote pool (cross-host over the control plane object store);
-  round-2 scope, interface reserved.
+- **G4** — remote pool: hash-addressed blocks in the control-plane object
+  store (storage.RemotePool), filled by G3 (or G2) spill and onboardable by
+  ANY worker — the cross-host tier (ref: CacheLevel::G4
+  block_manager.rs:62-75).
 
 Lookup walks tiers: G1 hit ⇒ free; G2/G3 hit ⇒ *onboard* (copy back into
 freshly allocated G1 blocks) — still far cheaper than recomputing prefill
@@ -47,8 +49,10 @@ class CacheLevel(enum.Enum):
 class KvbmMetrics:
     offloads_g2: int = 0
     offloads_g3: int = 0
+    offloads_g4: int = 0
     onboards_g2: int = 0
     onboards_g3: int = 0
+    onboards_g4: int = 0
     matched_tokens_g1: int = 0
     matched_tokens_tiered: int = 0
 
@@ -81,11 +85,18 @@ class KvBlockManager:
         self.allocator = allocator
         self.host = HostPool(capacity=host_blocks) if host_blocks > 0 else None
         self.disk = DiskPool(disk_dir, capacity=disk_blocks) if disk_dir and disk_blocks > 0 else None
+        self.remote = None  # G4 — attach_remote()
         self.metrics = KvbmMetrics()
         # Offload-on-eviction: copy out before the device block is reused.
         allocator.on_evict = self._offload_block
 
-    # --- offload cascade (G1 → G2 → G3) ------------------------------------
+    def attach_remote(self, remote) -> None:
+        """Enable the G4 remote tier (storage.RemotePool): deepest-spill
+        target of the offload cascade, onboardable by any worker sharing the
+        object store."""
+        self.remote = remote
+
+    # --- offload cascade (G1 → G2 → G3 → G4) --------------------------------
     def _offload_block(self, block_id: int, block_hash: int) -> None:
         if self.host is None:
             return
@@ -97,8 +108,15 @@ class KvBlockManager:
         if spilled is not None and self.disk is not None:
             sh, sk, sv = spilled
             if not self.disk.has(sh):
-                self.disk.put(sh, sk, sv)
+                spilled = self.disk.put(sh, sk, sv)
                 self.metrics.offloads_g3 += 1
+            else:
+                spilled = None
+        if spilled is not None and self.remote is not None:
+            sh, sk, sv = spilled
+            if not self.remote.has(sh):
+                self.remote.put(sh, sk, sv)
+                self.metrics.offloads_g4 += 1
 
     # --- tiered lookup ------------------------------------------------------
     def match_prefix(self, block_hashes: Sequence[int]) -> TieredMatch:
@@ -114,6 +132,8 @@ class KvBlockManager:
                 match.onboardable.append((h, CacheLevel.G2))
             elif self.disk is not None and self.disk.has(h):
                 match.onboardable.append((h, CacheLevel.G3))
+            elif self.remote is not None and self.remote.has(h):
+                match.onboardable.append((h, CacheLevel.G4))
             else:
                 break
         self.metrics.matched_tokens_tiered += len(match.onboardable)
@@ -135,9 +155,12 @@ class KvBlockManager:
             if tier == CacheLevel.G2:
                 entry = self.host.get(h)
                 self.metrics.onboards_g2 += 1
-            else:
+            elif tier == CacheLevel.G3:
                 entry = self.disk.get(h)
                 self.metrics.onboards_g3 += 1
+            else:
+                entry = self.remote.get(h)
+                self.metrics.onboards_g4 += 1
             if entry is None:  # raced out of the pool — stop onboarding here
                 idx = new_blocks.index(bid)
                 self.allocator.release(new_blocks[idx:])
@@ -159,6 +182,8 @@ class KvBlockManager:
             out["g2"] = self.host.usage()
         if self.disk is not None:
             out["g3"] = self.disk.usage()
+        if self.remote is not None:
+            out["g4_known_blocks"] = float(len(self.remote))
         return out
 
     def reset_tier(self, level: CacheLevel) -> int:

@@ -1,15 +1,21 @@
-"""Tier storage backends: host DRAM pool + disk pool.
+"""Tier storage backends: host DRAM pool, disk pool, remote (G4) pool.
 
 Ref: lib/llm/src/block_manager/storage.rs (``Storage`` trait,
 ``PinnedStorage``/``DiskStorage`` allocators) and pool/managed.rs (LRU
 inactive sets). Host blocks are plain numpy (the pinned-memory role — on TPU
 hosts, jax transfers from host numpy already use the fast path); disk blocks
-are one ``.npz`` per block hash (the reference's GDS file-per-layout role).
+are one ``.npz`` per block hash (the reference's GDS file-per-layout role);
+remote blocks are hash-addressed objects in the control-plane object store
+(``CacheLevel::G4``, block_manager.rs:62-75) — any worker can onboard blocks
+another worker spilled.
 """
 
 from __future__ import annotations
 
+import asyncio
+import io
 import os
+import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -80,18 +86,31 @@ class DiskPool:
     def has(self, block_hash: int) -> bool:
         return block_hash in self._index
 
-    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(
+        self, block_hash: int, k: np.ndarray, v: np.ndarray
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Store a block; returns the LRU entry evicted to make room (for
+        cascade to the next tier), or None."""
         if block_hash in self._index:
-            return
+            return None
+        spilled = None
         while len(self._index) >= self.capacity:
             h, path = self._index.popitem(last=False)
             try:
-                os.remove(path)
-            except OSError:
-                pass
+                if spilled is None:
+                    with np.load(path) as z:
+                        spilled = (h, z["k"], z["v"])
+            except (OSError, KeyError):
+                pass  # corrupt block: nothing to cascade
+            finally:
+                try:
+                    os.remove(path)  # always reclaim the file, even unreadable
+                except OSError:
+                    pass
         path = self._path(block_hash)
         np.savez(path, k=k, v=v)
         self._index[block_hash] = path
+        return spilled
 
     def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         path = self._index.get(block_hash)
@@ -120,3 +139,108 @@ class DiskPool:
 
     def __len__(self) -> int:
         return len(self._index)
+
+
+class RemotePool:
+    """G4: cross-host KV block pool on the control-plane object store
+    (ref: ``CacheLevel::G4``, lib/llm/src/block_manager.rs:62-75).
+
+    Blocks live under hash-addressed names in a shared bucket, so a block
+    spilled by worker A is onboardable by worker B. The pool is called from
+    the scheduler's step THREAD while the store client lives on the asyncio
+    loop — all store traffic goes through ``run_coroutine_threadsafe``:
+
+    - ``put`` is fire-and-forget (the offload cascade must not stall the
+      allocator's eviction hook on a network round-trip);
+    - ``has`` serves from a listing cache refreshed at most every
+      ``refresh_s`` (prefix walks probe many hashes);
+    - ``get`` blocks up to ``timeout_s`` (onboarding is already a copy).
+
+    Calling from the loop thread itself would deadlock; a guard raises
+    instead (production calls come from the engine's step thread).
+    """
+
+    def __init__(self, drt, loop: asyncio.AbstractEventLoop, *,
+                 bucket: str = "kvbm-g4", timeout_s: float = 5.0, refresh_s: float = 1.0):
+        self.drt = drt
+        self.loop = loop
+        self.bucket_name = bucket
+        self.timeout_s = timeout_s
+        self.refresh_s = refresh_s
+        self._known: set = set()
+        self._listed_at = 0.0
+
+    def _assert_worker_thread(self) -> None:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            raise RuntimeError(
+                "RemotePool must be called from a worker thread, not the event loop"
+            )
+
+    def _call(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(self.timeout_s)
+
+    async def _bucket(self):
+        return await self.drt.bus.object_store(self.bucket_name)
+
+    @staticmethod
+    def _name(block_hash: int) -> str:
+        return f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}"
+
+    def has(self, block_hash: int) -> bool:
+        if block_hash in self._known:
+            return True
+        self._assert_worker_thread()
+        now = time.monotonic()
+        if now - self._listed_at >= self.refresh_s:
+            async def _list():
+                return await (await self._bucket()).list()
+            try:
+                names = self._call(_list())
+            except Exception:  # noqa: BLE001 — a flaky store must not fail matching
+                # Back off: without this, every has() probe of a prefix walk
+                # would block the step thread up to timeout_s during an
+                # outage (one stalled listing per block hash).
+                self._listed_at = now
+                return False
+            self._known = set()
+            for n in names:
+                try:
+                    self._known.add(int(n, 16))
+                except ValueError:
+                    continue
+            self._listed_at = now
+        return block_hash in self._known
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        buf = io.BytesIO()
+        np.savez(buf, k=k, v=v)
+        data = buf.getvalue()
+
+        async def _put():
+            await (await self._bucket()).put(self._name(block_hash), data)
+
+        asyncio.run_coroutine_threadsafe(_put(), self.loop)  # fire-and-forget
+        self._known.add(block_hash)
+
+    def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        self._assert_worker_thread()
+
+        async def _get():
+            return await (await self._bucket()).get(self._name(block_hash))
+
+        try:
+            data = self._call(_get())
+        except Exception:  # noqa: BLE001
+            return None
+        if data is None:
+            self._known.discard(block_hash)
+            return None
+        with np.load(io.BytesIO(data)) as z:
+            return z["k"], z["v"]
+
+    def __len__(self) -> int:
+        return len(self._known)

@@ -51,6 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kvbm-host-blocks", type=int, default=0)
     p.add_argument("--kvbm-disk-dir", default=None)
     p.add_argument("--kvbm-disk-blocks", type=int, default=0)
+    p.add_argument("--kvbm-remote", action="store_true",
+                   help="enable the G4 remote KV tier on the control-plane object store")
     p.add_argument("--max-local-prefill-length", type=int, default=0)
     p.add_argument("--speedup-ratio", type=float, default=1.0, help="mocker time compression")
     p.add_argument("--kv-transfer", choices=["device", "host"], default="device",
@@ -109,6 +111,10 @@ async def amain(args) -> None:
                 spec_gamma=args.spec_gamma,
             )
         )
+        if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
+            from dynamo_tpu.llm.block_manager.storage import RemotePool
+
+            engine.kvbm.attach_remote(RemotePool(drt, asyncio.get_running_loop()))
 
     component = args.component or ("backend" if args.role == "aggregated" else args.role)
     ep = drt.namespace(args.namespace).component(component).endpoint(args.endpoint)

@@ -169,3 +169,85 @@ async def test_engine_determinism_across_offload_cycles():
         assert engine.kvbm.metrics.onboards_g2 > 0, "re-run must have onboarded"
     finally:
         await engine.stop()
+
+
+async def test_g4_remote_tier_cross_worker():
+    """VERDICT r2 #6: evict through G2/G3/G4 on worker A, onboard the same
+    blocks on worker B (separate KVBM, shared object store), contents
+    bit-identical. Ref: CacheLevel::G4 block_manager.rs:62-75,144."""
+    import asyncio
+
+    from dynamo_tpu.llm.block_manager.storage import RemotePool
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    loop = asyncio.get_running_loop()
+    try:
+        def make_worker(tmp=None, disk=0):
+            kvbm, cache, alloc = make_kvbm(num_device=5, host=1, disk=disk, tmp=tmp)
+            kvbm.attach_remote(RemotePool(drt, loop, refresh_s=0.0))
+            return kvbm, cache, alloc
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmp_a:
+            kvbm_a, cache_a, alloc_a = make_worker(tmp=tmp_a + "/a", disk=1)
+            tokens = list(range(64))
+            hashes = compute_block_hashes(tokens, 16)
+
+            def worker_a_evicts():
+                blocks = alloc_a.allocate(4)
+                contents = {h: fill_block(cache_a, b, float(i + 1))
+                            for i, (b, h) in enumerate(zip(blocks, hashes))}
+                alloc_a.register_hashes(blocks, hashes)
+                alloc_a.release(blocks)
+                # Evicting all 4 cascades: host holds 1, disk holds 1, the
+                # rest spill to G4 (remote).
+                alloc_a.allocate(4)
+                return contents
+
+            contents = await asyncio.to_thread(worker_a_evicts)
+            assert kvbm_a.metrics.offloads_g2 == 4
+            assert kvbm_a.metrics.offloads_g3 >= 1
+            assert kvbm_a.metrics.offloads_g4 >= 1
+            await asyncio.sleep(0.05)  # fire-and-forget puts land
+
+            # Worker B: fresh device cache + pools, same object store.
+            kvbm_b, cache_b, alloc_b = make_worker()
+
+            def worker_b_onboards():
+                match = kvbm_b.match_prefix(hashes)
+                tiers = [t for _, t in match.onboardable]
+                assert CacheLevel.G4 in tiers, tiers
+                device_blocks = kvbm_b.onboard(match, hashes)
+                return match, device_blocks
+
+            match, device_blocks = await asyncio.to_thread(worker_b_onboards)
+            assert kvbm_b.metrics.onboards_g4 >= 1
+
+            # The G4-onboarded prefix must be contiguous from the front (a
+            # tier miss ends the walk) and contents bit-identical.
+            from dynamo_tpu.llm.block_manager.transfer import gather_blocks
+
+            for bid, h in zip(device_blocks, hashes):
+                k_np, v_np = gather_blocks(cache_b, bid)
+                np.testing.assert_array_equal(k_np, contents[h])
+                np.testing.assert_array_equal(v_np, -contents[h])
+    finally:
+        await drt.shutdown()
+
+
+async def test_g4_loop_thread_guard():
+    """Calling the remote pool's blocking ops from the event-loop thread
+    must raise, not deadlock."""
+    import asyncio
+
+    from dynamo_tpu.llm.block_manager.storage import RemotePool
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    try:
+        pool = RemotePool(drt, asyncio.get_running_loop(), refresh_s=0.0)
+        with pytest.raises(RuntimeError, match="worker thread"):
+            pool.get(123)
+    finally:
+        await drt.shutdown()
