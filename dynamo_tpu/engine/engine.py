@@ -212,10 +212,13 @@ class TpuEngine:
         rid = context.id
         sampling_d = request.get("sampling_options") or {}
         temp = sampling_d.get("temperature")
+        seed = sampling_d.get("seed")
         sampling = SamplingParams(
             temperature=1.0 if temp is None else float(temp),  # null ≡ unset ≡ default
             top_k=int(sampling_d.get("top_k") or 0),
             top_p=float(sampling_d.get("top_p") or 1.0),
+            seed=int(seed) if seed is not None else None,
+            logprobs=bool(sampling_d.get("logprobs")),
         )
         stop = StopConditions.from_dict(request.get("stop_conditions"))
         disagg = request.get("disagg_params") or {}
@@ -254,6 +257,8 @@ class TpuEngine:
                     "finish_reason": out.finish_reason,
                     "index": 0,
                 }
+                if out.logprob is not None:
+                    frame["logprobs"] = [out.logprob]
                 yield frame
                 if out.finished:
                     finished = True

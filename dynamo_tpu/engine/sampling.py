@@ -21,7 +21,12 @@ class SamplingParams:
     temperature: float = 1.0
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0
+    # Per-request PRNG: same seed + same prompt ⇒ same sample sequence,
+    # independent of batch composition (the key folds in the per-request
+    # token position, not the global step counter).
     seed: Optional[int] = None
+    # Return the chosen token's log-probability with each step.
+    logprobs: bool = False
     # Per-request processors (dynamo_tpu.logits_processing) — host path.
     logits_processors: List = field(default_factory=list)
 
@@ -63,10 +68,12 @@ def sample_batch(
     top_k: jax.Array,  # [B] i32 (0 = off)
     top_p: jax.Array,  # [B] f32 (1 = off)
     key: jax.Array,
+    row_keys: Optional[jax.Array] = None,  # [B, 2] per-row PRNG keys (seeded requests)
 ) -> jax.Array:
     """Sample one token per row honouring per-row parameters. Greedy rows
     (temperature 0) take argmax; all-greedy batches skip sampling entirely
-    (runtime branch — the common temperature=0 serving case)."""
+    (runtime branch — the common temperature=0 serving case). With
+    ``row_keys`` each row draws from its own key (per-request seeds)."""
     B, V = logits.shape
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -101,7 +108,12 @@ def sample_batch(
             window_exact, windowed, lambda _: _exact_thresholds(scaled, lse, top_k, top_p), None
         )
         masked = jnp.where(scaled >= thresh[:, None], scaled, -jnp.inf)
-        sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+        if row_keys is not None:
+            sampled = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row)
+            )(row_keys, masked).astype(jnp.int32)
+        else:
+            sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
         return jnp.where(temperature > 0, sampled, greedy_tok)
 
     return jax.lax.cond(jnp.any(temperature > 0), sample_path, lambda _: greedy_tok, None)

@@ -114,6 +114,9 @@ class Sequence:
     # Speculative decoding: positions coherently materialized in the DRAFT
     # cache (the draft mirrors the target's block tables; see _decode_spec).
     d_n: int = 0
+    # Chosen-token logprob computed by the single-row sampler, consumed by
+    # the next _append_token (sampling.logprobs requests).
+    _pending_logprob: Optional[float] = None
 
     @property
     def all_ids(self) -> List[int]:
@@ -560,7 +563,9 @@ class Scheduler:
         if (
             self.draft_params is not None
             and not any(
-                seq.sampling.temperature != 0.0 or seq.sampling.logits_processors
+                seq.sampling.temperature != 0.0
+                or seq.sampling.logits_processors
+                or seq.sampling.logprobs
                 for seq in batch
             )
             and self._decode_spec(batch, bucket, outputs)
@@ -571,7 +576,12 @@ class Scheduler:
             self.sc.num_scheduler_steps > 1
             and self._supports_multi_step
             and not self.waiting  # don't delay admissions by a whole window
-            and not any(seq.sampling.logits_processors for seq in batch)
+            and not any(
+                seq.sampling.logits_processors
+                or seq.sampling.logprobs
+                or (seq.sampling.seed is not None and seq.sampling.temperature > 0)
+                for seq in batch
+            )
             and self._decode_multi(batch, bucket, outputs)
         ):
             return outputs
@@ -626,9 +636,29 @@ class Scheduler:
             logits = jnp.asarray(rows)
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
+        row_keys = None
+        if any(seq.sampling.seed is not None for seq in batch):
+            # Unseeded rows fold their row index too — in the vmap path every
+            # row draws from its own key, so sharing one would correlate all
+            # unseeded rows' samples.
+            row_keys = jnp.stack(
+                [
+                    self._row_key(batch[i])
+                    if i < len(batch) and batch[i].sampling.seed is not None
+                    else jax.random.fold_in(key, i)
+                    for i in range(bucket)
+                ]
+            )
         sampled = np.asarray(
-            self._sample_jit(logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key)
+            self._sample_jit(
+                logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
+            )
         )
+        logprobs_np = None
+        if any(seq.sampling.logprobs for seq in batch):
+            from dynamo_tpu.engine.sampling import compute_logprobs
+
+            logprobs_np = np.asarray(compute_logprobs(logits, jnp.asarray(sampled)))
 
         for i, seq in enumerate(batch):
             if seq.state != SeqState.RUNNING:
@@ -636,7 +666,8 @@ class Scheduler:
             self._ensure_block_capacity(seq)
             if seq.state != SeqState.RUNNING:
                 continue  # itself preempted (no candidate to evict)
-            self._append_token(seq, int(sampled[i]), outputs)
+            lp = float(logprobs_np[i]) if logprobs_np is not None and seq.sampling.logprobs else None
+            self._append_token(seq, int(sampled[i]), outputs, logprob=lp)
         return outputs
 
     def _decode_multi(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
@@ -938,9 +969,16 @@ class Scheduler:
         logger.info("preempted %s (len %d) to free blocks", victim.request_id, victim.total_len)
         return True
 
+    def _row_key(self, seq: Sequence) -> jax.Array:
+        """Per-row PRNG key. Seeded requests fold the per-request position
+        (same seed + prompt ⇒ same samples, whatever the batch around them);
+        unseeded rows fold the global step counter."""
+        if seq.sampling.seed is not None:
+            return jax.random.fold_in(jax.random.PRNGKey(seq.sampling.seed), len(seq.output_ids))
+        return jax.random.fold_in(self._rng, self._step_counter)
+
     def _sample_one(self, seq: Sequence, logits: jax.Array) -> int:
         self._step_counter += 1
-        key = jax.random.fold_in(self._rng, self._step_counter)
         s = seq.sampling
         if s.logits_processors:
             from dynamo_tpu.logits_processing import apply_chain
@@ -951,19 +989,33 @@ class Scheduler:
             jnp.asarray([s.temperature], dtype=jnp.float32),
             jnp.asarray([s.top_k], dtype=jnp.int32),
             jnp.asarray([s.top_p], dtype=jnp.float32),
-            key,
+            self._row_key(seq),
         )
-        return int(np.asarray(tok)[0])
+        token = int(np.asarray(tok)[0])
+        if s.logprobs:
+            from dynamo_tpu.engine.sampling import compute_logprobs
 
-    def _append_token(self, seq: Sequence, token: int, outputs: List[tuple]) -> None:
+            seq._pending_logprob = float(
+                np.asarray(compute_logprobs(logits[None, :], jnp.asarray([token])))[0]
+            )
+        return token
+
+    def _append_token(
+        self, seq: Sequence, token: int, outputs: List[tuple], logprob: Optional[float] = None
+    ) -> None:
+        if logprob is None:
+            logprob = getattr(seq, "_pending_logprob", None)
+            seq._pending_logprob = None
         seq.output_ids.append(token)
         reason = self._check_stop(seq, token)
         if reason is not None:
             # Token that triggered 'stop' is still emitted (backend strips).
-            outputs.append((seq, StepOutput(token_id=token, finished=True, finish_reason=reason)))
+            outputs.append(
+                (seq, StepOutput(token_id=token, finished=True, finish_reason=reason, logprob=logprob))
+            )
             self._finish(seq, reason, outputs, emit=False)
         else:
-            outputs.append((seq, StepOutput(token_id=token)))
+            outputs.append((seq, StepOutput(token_id=token, logprob=logprob)))
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:
         n_out = len(seq.output_ids)

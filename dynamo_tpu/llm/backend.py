@@ -96,6 +96,7 @@ class Backend(Operator):
                 token_ids=out.token_ids,
                 text=text or None,
                 finish_reason=finish,
+                logprobs=out.logprobs,
                 index=out.index,
                 tool_calls=tool_calls,
                 reasoning=reasoning,
@@ -135,6 +136,7 @@ class Backend(Operator):
                         data=LLMEngineOutput(
                             token_ids=out.token_ids,
                             text=emit_text,
+                            logprobs=out.logprobs,
                             index=out.index,
                             reasoning=reasoning_delta,
                         ).to_wire()

@@ -282,54 +282,112 @@ class HttpService:
             self._m_inflight(model).dec()
             self._m_duration(model).observe(time.monotonic() - start)
 
+    @staticmethod
+    def _choice_bodies(body: dict) -> list:
+        """Per-choice request bodies for n>1: each choice is an independent
+        generation; seeded requests get seed+i so choices differ the way
+        OpenAI's do (ref: protocols/openai n handling)."""
+        n = int(body.get("n") or 1)
+        if n == 1:
+            return [body]
+        out = []
+        for i in range(n):
+            b = dict(body)
+            b["n"] = 1
+            if body.get("seed") is not None:
+                b["seed"] = int(body["seed"]) + i
+            out.append(b)
+        return out
+
     async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
-        text_parts = []
-        reasoning_parts = []
-        tool_calls = None
-        n_tokens = 0
-        prompt_tokens = 0
-        finish_reason = "stop"
-        first_tok_at = None
-        try:
-            async for item in engine.generate(body, ctx):
+        bodies = self._choice_bodies(body)
+        prompt_tokens_box = [0]
+        first_box = [None]
+
+        async def run_choice(i: int, b: dict, c: Context) -> dict:
+            text_parts = []
+            reasoning_parts = []
+            tool_calls = None
+            n_tokens = 0
+            finish_reason = "stop"
+            logprobs: list = []
+            async for item in engine.generate(b, c):
                 if isinstance(item, Annotated) and item.is_annotation():
-                    if item.event == "_metrics":
-                        prompt_tokens = int(item.comment or 0)
-                        self._m_input_tokens(model).inc(prompt_tokens)
+                    if item.event == "_metrics" and i == 0:
+                        prompt_tokens_box[0] = int(item.comment or 0)
+                        self._m_input_tokens(model).inc(prompt_tokens_box[0])
                     continue
                 out = _as_output(item)
                 if out is None:
                     continue
                 if out.text:
-                    if first_tok_at is None:
-                        first_tok_at = time.monotonic()
-                        self._m_ttft(model).observe(first_tok_at - start)
+                    if first_box[0] is None:
+                        first_box[0] = time.monotonic()
+                        self._m_ttft(model).observe(first_box[0] - start)
                     text_parts.append(out.text)
                 if out.reasoning:
                     reasoning_parts.append(out.reasoning)
                 if out.tool_calls:
                     tool_calls = out.tool_calls
+                if out.logprobs:
+                    logprobs.extend(out.logprobs)
                 n_tokens += len(out.token_ids)
                 if out.finish_reason:
                     finish_reason = out.finish_reason
+            return {
+                "index": i,
+                "text": "".join(text_parts),
+                "reasoning": "".join(reasoning_parts) or None,
+                "tool_calls": tool_calls,
+                "finish_reason": finish_reason,
+                "n_tokens": n_tokens,
+                "logprobs": logprobs,
+            }
+
+        ctxs = [ctx] + [ctx.child() for _ in bodies[1:]]
+        tasks = [
+            asyncio.create_task(run_choice(i, b, c))
+            for i, (b, c) in enumerate(zip(bodies, ctxs))
+        ]
+        try:
+            results = await asyncio.gather(*tasks)
         except Exception as e:
+            # Stop and reap the sibling choices — leaving them running wastes
+            # engine work and leaks never-retrieved task exceptions.
+            for c in ctxs:
+                c.stop_generating()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             logger.exception("request %s failed", ctx.id)
             self._m_requests(model, "500").inc()
             return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
         self._m_requests(model, "200").inc()
-        self._m_output_tokens(model).inc(n_tokens)
-        usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
-        text = "".join(text_parts)
+        total_tokens = sum(r["n_tokens"] for r in results)
+        self._m_output_tokens(model).inc(total_tokens)
+        usage = oai.usage_dict(prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens)
         if kind == "chat":
-            return web.json_response(
-                oai.chat_response(
-                    rid, model, text, finish_reason, usage,
-                    tool_calls=tool_calls, reasoning="".join(reasoning_parts) or None,
+            choices = [
+                oai.chat_choice(
+                    r["index"], r["text"], r["finish_reason"], r["tool_calls"], r["reasoning"],
+                    logprobs=oai.chat_logprobs_content(None, r["logprobs"]) if r["logprobs"] else None,
                 )
+                for r in results
+            ]
+            return web.json_response(oai.chat_response_multi(rid, model, choices, usage))
+        choices = [
+            oai.completion_choice(
+                r["index"], r["text"], r["finish_reason"],
+                logprobs=oai.completion_logprobs_block([""] * len(r["logprobs"]), r["logprobs"])
+                if r["logprobs"] else None,
             )
-        return web.json_response(oai.completion_response(rid, model, text, finish_reason, usage))
+            for r in results
+        ]
+        return web.json_response(oai.completion_response_multi(rid, model, choices, usage))
 
     async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
+        if int(body.get("n") or 1) > 1:
+            return await self._serve_stream_multi(request, engine, body, ctx, rid, kind, model, start)
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -368,11 +426,21 @@ class HttpService:
                     n_tokens += len(out.token_ids)
                 if out.reasoning and kind == "chat":
                     await _sse(resp, oai.chat_chunk(rid, model, {"reasoning_content": out.reasoning}))
-                if out.text:
+                if out.text or out.logprobs:
+                    # Tokens whose text is withheld (detok partials / stop
+                    # jail) still stream their logprobs on an empty delta.
+                    text = out.text or ""
+                    lp = None
+                    if out.logprobs:
+                        lp = (
+                            oai.chat_logprobs_content(text, out.logprobs)
+                            if kind == "chat"
+                            else oai.completion_logprobs_block([text], out.logprobs)
+                        )
                     if kind == "chat":
-                        await _sse(resp, oai.chat_chunk(rid, model, {"content": out.text}))
+                        await _sse(resp, oai.chat_chunk(rid, model, {"content": text}, logprobs=lp))
                     else:
-                        await _sse(resp, oai.completion_chunk(rid, model, out.text))
+                        await _sse(resp, oai.completion_chunk(rid, model, text, logprobs=lp))
                 if out.tool_calls and kind == "chat":
                     delta_calls = [
                         {**tc, "index": i, "function": tc["function"]}
@@ -396,6 +464,99 @@ class HttpService:
             status = "500"
             await _sse(resp, oai.error_body(str(e), "internal_error", 500))
         finally:
+            self._m_requests(model, status).inc()
+            self._m_output_tokens(model).inc(n_tokens)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def _serve_stream_multi(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
+        """n>1 streaming: one generation per choice, chunks multiplexed onto
+        one SSE stream with their choice index (ref: OpenAI n semantics)."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        bodies = self._choice_bodies(body)
+        ctxs = [ctx] + [Context() for _ in bodies[1:]]
+        queue: "asyncio.Queue" = asyncio.Queue()
+        n_tokens = 0
+        status = "200"
+
+        async def pump(i: int, b: dict, c: Context):
+            try:
+                async for item in engine.generate(b, c):
+                    if isinstance(item, Annotated) and item.is_annotation():
+                        if item.event == "_metrics" and i == 0:
+                            self._m_input_tokens(model).inc(int(item.comment or 0))
+                        continue
+                    out = _as_output(item)
+                    if out is not None:
+                        await queue.put((i, out, None))
+            except Exception as e:  # noqa: BLE001 — surfaced on the stream
+                await queue.put((i, None, e))
+            finally:
+                await queue.put((i, None, None))  # choice done
+
+        tasks = [asyncio.create_task(pump(i, b, c)) for i, (b, c) in enumerate(zip(bodies, ctxs))]
+        live = len(tasks)
+        try:
+            if kind == "chat":
+                for i in range(len(bodies)):
+                    await _sse(resp, oai.chat_chunk(rid, model, {"role": "assistant", "content": ""}, index=i))
+            while live:
+                i, out, err = await queue.get()
+                if err is not None:
+                    raise err
+                if out is None:
+                    live -= 1
+                    continue
+                n_tokens += len(out.token_ids)
+                if out.reasoning and kind == "chat":
+                    await _sse(resp, oai.chat_chunk(rid, model, {"reasoning_content": out.reasoning}, index=i))
+                if out.text or out.logprobs:
+                    text = out.text or ""
+                    lp = None
+                    if out.logprobs:
+                        lp = (
+                            oai.chat_logprobs_content(text, out.logprobs)
+                            if kind == "chat"
+                            else oai.completion_logprobs_block([text], out.logprobs)
+                        )
+                    if kind == "chat":
+                        await _sse(resp, oai.chat_chunk(rid, model, {"content": text}, index=i, logprobs=lp))
+                    else:
+                        await _sse(resp, oai.completion_chunk(rid, model, text, index=i, logprobs=lp))
+                if out.tool_calls and kind == "chat":
+                    delta_calls = [
+                        {**tc, "index": j, "function": tc["function"]}
+                        for j, tc in enumerate(out.tool_calls)
+                    ]
+                    await _sse(resp, oai.chat_chunk(rid, model, {"tool_calls": delta_calls}, index=i))
+                if out.finish_reason:
+                    chunk = (
+                        oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason, index=i)
+                        if kind == "chat"
+                        else oai.completion_chunk(rid, model, "", finish_reason=out.finish_reason, index=i)
+                    )
+                    await _sse(resp, chunk)
+        except (ConnectionResetError, asyncio.CancelledError):
+            status = "499"
+            raise
+        except Exception as e:
+            logger.exception("stream %s failed", ctx.id)
+            status = "500"
+            await _sse(resp, oai.error_body(str(e), "internal_error", 500))
+        finally:
+            for c in ctxs:
+                c.stop_generating()
+            for t in tasks:
+                t.cancel()
             self._m_requests(model, status).inc()
             self._m_output_tokens(model).inc(n_tokens)
         await resp.write(b"data: [DONE]\n\n")

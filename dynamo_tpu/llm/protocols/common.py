@@ -94,6 +94,7 @@ class LLMEngineOutput:
     text: Optional[str] = None  # set by the Backend detokenizer
     finish_reason: Optional[str] = None
     cum_log_probs: Optional[float] = None
+    logprobs: Optional[List[float]] = None  # per-token chosen logprobs (aligned with token_ids)
     index: int = 0
     # Set by the Backend parser stage on the final frame (OpenAI wire shape).
     tool_calls: Optional[List[dict]] = None
@@ -107,6 +108,8 @@ class LLMEngineOutput:
             d["finish_reason"] = self.finish_reason
         if self.cum_log_probs is not None:
             d["cum_log_probs"] = self.cum_log_probs
+        if self.logprobs is not None:
+            d["logprobs"] = self.logprobs
         if self.tool_calls is not None:
             d["tool_calls"] = self.tool_calls
         if self.reasoning is not None:
@@ -120,6 +123,7 @@ class LLMEngineOutput:
             text=d.get("text"),
             finish_reason=d.get("finish_reason"),
             cum_log_probs=d.get("cum_log_probs"),
+            logprobs=d.get("logprobs"),
             index=d.get("index", 0),
             tool_calls=d.get("tool_calls"),
             reasoning=d.get("reasoning"),

@@ -42,14 +42,34 @@ def validate_chat_request(body: dict) -> dict:
     _require(tp is None or 0.0 < tp <= 1.0, "top_p must be in (0, 1]")
     mt = body.get("max_tokens") or body.get("max_completion_tokens")
     _require(mt is None or (isinstance(mt, int) and mt > 0), "max_tokens must be a positive integer")
-    n = body.get("n")
-    _require(n is None or n == 1, "n > 1 is not supported")
+    _validate_common_sampling(body)
+    lp = body.get("logprobs")
+    _require(lp is None or isinstance(lp, bool), "logprobs must be a boolean")
+    tlp = body.get("top_logprobs")
+    _require(
+        tlp is None or (isinstance(tlp, int) and 0 <= tlp <= 20),
+        "top_logprobs must be an integer in [0, 20]",
+    )
+    _require(tlp is None or bool(lp), "top_logprobs requires logprobs: true")
     stop = body.get("stop")
     _require(
         stop is None or isinstance(stop, str) or (isinstance(stop, list) and all(isinstance(s, str) for s in stop)),
         "stop must be a string or array of strings",
     )
     return body
+
+
+MAX_N = 8  # per-request choice fan-out cap (each choice is a full generation)
+
+
+def _validate_common_sampling(body: dict) -> None:
+    n = body.get("n")
+    _require(
+        n is None or (isinstance(n, int) and 1 <= n <= MAX_N),
+        f"n must be an integer in [1, {MAX_N}]",
+    )
+    seed = body.get("seed")
+    _require(seed is None or isinstance(seed, int), "seed must be an integer")
 
 
 def validate_completion_request(body: dict) -> dict:
@@ -61,15 +81,29 @@ def validate_completion_request(body: dict) -> dict:
         or (isinstance(prompt, list) and all(isinstance(p, (str, int)) for p in prompt)),
         "prompt must be a string, array of strings, or array of token ids",
     )
+    _validate_common_sampling(body)
+    lp = body.get("logprobs")
+    _require(
+        lp is None or (isinstance(lp, int) and 0 <= lp <= 5),
+        "logprobs must be an integer in [0, 5]",
+    )
     return body
 
 
 def sampling_from_request(body: dict) -> Dict[str, Any]:
-    return {
+    out = {
         k: body.get(k)
         for k in ("temperature", "top_p", "top_k", "seed", "frequency_penalty", "presence_penalty")
         if body.get(k) is not None
     }
+    # Chat uses a boolean, completions an int count; either turns on
+    # chosen-token logprobs engine-side. Completions ``logprobs: 0`` still
+    # returns chosen-token logprobs (OpenAI semantics) — only absent/False
+    # means off.
+    lp = body.get("logprobs")
+    if lp is not None and lp is not False:
+        out["logprobs"] = True
+    return out
 
 
 def stop_conditions_from_request(body: dict, eos_token_ids: Optional[List[int]] = None) -> Dict[str, Any]:
@@ -92,23 +126,82 @@ def make_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex[:24]}"
 
 
+def chat_logprobs_content(text: Optional[str], logprobs: List[float]) -> dict:
+    """Chat logprobs block for one delta/message: one entry per generated
+    token (chosen-token logprob; ``top_logprobs`` entries are not populated
+    beyond the chosen token)."""
+    toks = [text] if (text and len(logprobs) == 1) else [""] * len(logprobs)
+    return {
+        "content": [
+            {"token": t, "logprob": lp, "bytes": list(t.encode()) if t else None, "top_logprobs": []}
+            for t, lp in zip(toks, logprobs)
+        ]
+    }
+
+
+def completion_logprobs_block(texts: List[str], logprobs: List[float]) -> dict:
+    """Completions-style logprobs arrays (tokens / token_logprobs)."""
+    return {
+        "tokens": texts,
+        "token_logprobs": logprobs,
+        "top_logprobs": None,
+        "text_offset": [],
+    }
+
+
 def chat_chunk(
     rid: str,
     model: str,
     delta: dict,
     finish_reason: Optional[str] = None,
     usage: Optional[dict] = None,
+    index: int = 0,
+    logprobs: Optional[dict] = None,
 ) -> dict:
+    choice = {"index": index, "delta": delta, "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     out = {
         "id": rid,
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if usage is not None:
         out["usage"] = usage
     return out
+
+
+def chat_choice(
+    index: int,
+    text: str,
+    finish_reason: str,
+    tool_calls: Optional[list] = None,
+    reasoning: Optional[str] = None,
+    logprobs: Optional[dict] = None,
+) -> dict:
+    message: dict = {"role": "assistant", "content": text}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = text or None
+    if reasoning:
+        message["reasoning_content"] = reasoning
+    choice = {"index": index, "message": message, "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
+    return choice
+
+
+def chat_response_multi(rid: str, model: str, choices: List[dict], usage: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": usage,
+    }
 
 
 def chat_response(
@@ -119,48 +212,62 @@ def chat_response(
     usage: dict,
     tool_calls: Optional[list] = None,
     reasoning: Optional[str] = None,
+    logprobs: Optional[dict] = None,
 ) -> dict:
-    message: dict = {"role": "assistant", "content": text}
-    if tool_calls:
-        message["tool_calls"] = tool_calls
-        message["content"] = text or None
-    if reasoning:
-        message["reasoning_content"] = reasoning
-    return {
-        "id": rid,
-        "object": "chat.completion",
-        "created": int(time.time()),
-        "model": model,
-        "choices": [
-            {
-                "index": 0,
-                "message": message,
-                "finish_reason": finish_reason,
-            }
-        ],
-        "usage": usage,
-    }
+    return chat_response_multi(
+        rid, model,
+        [chat_choice(0, text, finish_reason, tool_calls, reasoning, logprobs)],
+        usage,
+    )
 
 
-def completion_chunk(rid: str, model: str, text: str, finish_reason: Optional[str] = None) -> dict:
+def completion_chunk(
+    rid: str,
+    model: str,
+    text: str,
+    finish_reason: Optional[str] = None,
+    index: int = 0,
+    logprobs: Optional[dict] = None,
+) -> dict:
+    choice = {"index": index, "text": text, "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": rid,
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
 
 
-def completion_response(rid: str, model: str, text: str, finish_reason: str, usage: dict) -> dict:
+def completion_choice(
+    index: int, text: str, finish_reason: str, logprobs: Optional[dict] = None
+) -> dict:
+    choice = {"index": index, "text": text, "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
+    return choice
+
+
+def completion_response_multi(rid: str, model: str, choices: List[dict], usage: dict) -> dict:
     return {
         "id": rid,
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "choices": choices,
         "usage": usage,
     }
+
+
+def completion_response(
+    rid: str, model: str, text: str, finish_reason: str, usage: dict,
+    logprobs: Optional[dict] = None,
+) -> dict:
+    return completion_response_multi(
+        rid, model, [completion_choice(0, text, finish_reason, logprobs)], usage
+    )
 
 
 def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
