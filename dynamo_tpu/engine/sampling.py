@@ -119,6 +119,28 @@ def sample_batch(
     return jax.lax.cond(jnp.any(temperature > 0), sample_path, lambda _: greedy_tok, None)
 
 
+@jax.jit
+def make_row_keys(
+    base_key: jax.Array,
+    seeds: jax.Array,  # [B] i32 (0 where unseeded)
+    positions: jax.Array,  # [B] i32 per-request token position
+    has_seed: jax.Array,  # [B] bool
+) -> jax.Array:
+    """Per-row sampling keys in ONE dispatch (a per-row Python loop of
+    fold_in calls costs ~B tiny dispatches on the decode hot path): seeded
+    rows fold their request position into PRNGKey(seed) — batch-composition
+    independent — while unseeded rows fold their row index into the step's
+    base key."""
+
+    def mk(seed, pos, i, has):
+        seeded = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        unseeded = jax.random.fold_in(base_key, i)
+        return jnp.where(has, seeded, unseeded)
+
+    idx = jnp.arange(seeds.shape[0], dtype=jnp.int32)
+    return jax.vmap(mk)(seeds, positions, idx, has_seed)
+
+
 def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Log-probability of chosen tokens. logits [B, V], tokens [B] → [B]."""
     logp = jax.nn.log_softmax(logits, axis=-1)

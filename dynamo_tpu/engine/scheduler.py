@@ -638,16 +638,18 @@ class Scheduler:
         key = jax.random.fold_in(self._rng, self._step_counter)
         row_keys = None
         if any(seq.sampling.seed is not None for seq in batch):
-            # Unseeded rows fold their row index too — in the vmap path every
-            # row draws from its own key, so sharing one would correlate all
-            # unseeded rows' samples.
-            row_keys = jnp.stack(
-                [
-                    self._row_key(batch[i])
-                    if i < len(batch) and batch[i].sampling.seed is not None
-                    else jax.random.fold_in(key, i)
-                    for i in range(bucket)
-                ]
+            from dynamo_tpu.engine.sampling import make_row_keys
+
+            seeds = np.zeros((bucket,), dtype=np.int32)
+            poss_out = np.zeros((bucket,), dtype=np.int32)
+            has_seed = np.zeros((bucket,), dtype=bool)
+            for i, seq in enumerate(batch):
+                if seq.sampling.seed is not None:
+                    seeds[i] = seq.sampling.seed
+                    poss_out[i] = len(seq.output_ids)
+                    has_seed[i] = True
+            row_keys = make_row_keys(
+                key, jnp.asarray(seeds), jnp.asarray(poss_out), jnp.asarray(has_seed)
             )
         sampled = np.asarray(
             self._sample_jit(

@@ -29,6 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--kv-cache-block-size", type=int, default=16)
+    p.add_argument("--tls-cert-path", default=None, help="PEM cert: serve HTTPS")
+    p.add_argument("--tls-key-path", default=None, help="PEM private key")
     return p
 
 
@@ -45,6 +47,8 @@ async def amain(args) -> None:
         kv_overlap_score_weight=args.kv_overlap_score_weight,
         kv_temperature=args.router_temperature,
         namespace=args.namespace,
+        tls_cert=args.tls_cert_path,
+        tls_key=args.tls_key_path,
     )
     service = await start_frontend(drt, config)
     logger.info("frontend ready on %s:%d (router=%s)", args.http_host, service.port, args.router_mode)

@@ -115,6 +115,9 @@ class FrontendConfig:
     kv_overlap_score_weight: float = 1.0
     kv_temperature: float = 0.0
     namespace: str = "dynamo"
+    # TLS termination (ref frontend --tls-cert-path/--tls-key-path).
+    tls_cert: Optional[str] = None
+    tls_key: Optional[str] = None
 
 
 async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> HttpService:
@@ -148,7 +151,10 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
 
     watcher = ModelWatcher(drt, manager, engine_factory)
     await watcher.start()
-    service = HttpService(manager, host=config.host, port=config.port)
+    service = HttpService(
+        manager, host=config.host, port=config.port,
+        tls_cert=config.tls_cert, tls_key=config.tls_key,
+    )
     service.watcher = watcher  # keep alive / stoppable
     await service.start()
     if config.grpc_port is not None:

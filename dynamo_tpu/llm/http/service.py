@@ -45,10 +45,23 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8000,
         metrics: Optional[MetricsRegistry] = None,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ):
         self.manager = manager
         self.host = host
         self.port = port
+        # TLS termination (ref: frontend --tls-cert-path/--tls-key-path,
+        # components/frontend/src/dynamo/frontend/main.py:81-286): both paths
+        # or neither.
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("TLS needs both tls_cert and tls_key")
+        self._ssl = None
+        if tls_cert:
+            import ssl
+
+            self._ssl = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl.load_cert_chain(tls_cert, tls_key)
         self.metrics = metrics or MetricsRegistry(prefix=FRONTEND_PREFIX)
         self._runner: Optional[web.AppRunner] = None
         # Optional KServe gRPC twin sharing this manager; attached by the
@@ -86,12 +99,20 @@ class HttpService:
         return app
 
     async def start(self) -> None:
+        import socket as _socket
+
         self._runner = web.AppRunner(self.build_app(), access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        # Bind the socket ourselves: aiohttp exposes no public API for the
+        # OS-assigned port when port=0 (reaching into site._server.sockets is
+        # a private-API trap across versions).
+        sock = _socket.create_server((self.host, self.port), reuse_port=False)
+        self.port = sock.getsockname()[1]
+        site = web.SockSite(self._runner, sock, ssl_context=self._ssl)
         await site.start()
-        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-        logger.info("OpenAI HTTP frontend on %s:%d", self.host, self.port)
+        logger.info(
+            "OpenAI HTTP%s frontend on %s:%d", "S" if self._ssl else "", self.host, self.port
+        )
 
     async def stop(self) -> None:
         try:
@@ -344,7 +365,10 @@ class HttpService:
                 "logprobs": logprobs,
             }
 
-        ctxs = [ctx] + [ctx.child() for _ in bodies[1:]]
+        # Children need UNIQUE ids: the engine keys sequences by context.id,
+        # so sharing the parent's id would collide all n choices in the
+        # scheduler (un-abortable orphans once one finishes).
+        ctxs = [ctx] + [ctx.child(id=f"{ctx.id}-c{i}") for i in range(1, len(bodies))]
         tasks = [
             asyncio.create_task(run_choice(i, b, c))
             for i, (b, c) in enumerate(zip(bodies, ctxs))
@@ -483,7 +507,9 @@ class HttpService:
         )
         await resp.prepare(request)
         bodies = self._choice_bodies(body)
-        ctxs = [ctx] + [Context() for _ in bodies[1:]]
+        # Unique-id children of the request context: sequences key on the id
+        # (collision = orphaned choices) and children inherit the traceparent.
+        ctxs = [ctx] + [ctx.child(id=f"{ctx.id}-c{i}") for i in range(1, len(bodies))]
         queue: "asyncio.Queue" = asyncio.Queue()
         n_tokens = 0
         status = "200"

@@ -51,6 +51,9 @@ def validate_chat_request(body: dict) -> dict:
         "top_logprobs must be an integer in [0, 20]",
     )
     _require(tlp is None or bool(lp), "top_logprobs requires logprobs: true")
+    # Only chosen-token logprobs are computed today; reject rather than
+    # silently return empty alternatives.
+    _require(not tlp, "top_logprobs > 0 is not supported (chosen-token logprobs only)")
     stop = body.get("stop")
     _require(
         stop is None or isinstance(stop, str) or (isinstance(stop, list) and all(isinstance(s, str) for s in stop)),
@@ -140,12 +143,14 @@ def chat_logprobs_content(text: Optional[str], logprobs: List[float]) -> dict:
 
 
 def completion_logprobs_block(texts: List[str], logprobs: List[float]) -> dict:
-    """Completions-style logprobs arrays (tokens / token_logprobs)."""
+    """Completions-style logprobs arrays (tokens / token_logprobs).
+    ``text_offset`` is omitted: per-token character offsets are not tracked
+    through streaming detokenization, and an empty array misaligned with
+    ``tokens`` is worse for zip/index consumers than absence."""
     return {
         "tokens": texts,
         "token_logprobs": logprobs,
         "top_logprobs": None,
-        "text_offset": [],
     }
 
 

@@ -181,3 +181,53 @@ async def test_distributed_discovery_and_serving():
     finally:
         await engine.stop()
         await drt.shutdown()
+
+
+async def test_tls_frontend(tmp_path):
+    """HTTPS serving with --tls-cert/--tls-key (ref: frontend --tls-*-path
+    flags, components/frontend main.py:81-286). Self-signed cert; the client
+    pins it."""
+    import ssl
+    import subprocess
+    import sys
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    gen = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True,
+    )
+    if gen.returncode != 0:
+        pytest.skip(f"openssl unavailable: {gen.stderr[-120:]}")
+
+    engine = tiny_engine()
+    manager = ModelManager()
+    manager.add_model("chat", MODEL, build_local_pipeline(ByteTokenizer(), engine))
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          tls_cert=str(cert), tls_key=str(key))
+    await service.start()
+    try:
+        client_ssl = ssl.create_default_context(cafile=str(cert))
+        client_ssl.check_hostname = False
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"https://127.0.0.1:{service.port}/health", ssl=client_ssl) as r:
+                assert r.status == 200
+            async with s.post(
+                f"https://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": MODEL, "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3},
+                ssl=client_ssl,
+            ) as r:
+                assert r.status == 200
+                assert (await r.json())["choices"][0]["message"]["content"]
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+def test_tls_requires_both_paths():
+    manager = ModelManager()
+    with pytest.raises(ValueError, match="both"):
+        HttpService(manager, tls_cert="/tmp/x.pem")

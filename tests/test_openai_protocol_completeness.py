@@ -1,0 +1,153 @@
+"""OpenAI protocol completeness (VERDICT r2 #7): logprobs (unary + stream
+deltas), per-request seed determinism, n>1 choices, and OpenAI-shaped
+validation errors. Ref: lib/llm/src/protocols/openai/*,
+http/service/openai.rs:481."""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.protocols import openai as oai
+from tests.test_http_serve import MODEL, make_local_service
+
+
+def chat_body(**kw):
+    body = {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": "hello protocol tests"}],
+        "max_tokens": 6,
+    }
+    body.update(kw)
+    return body
+
+
+# --- validation (OpenAI-shaped errors) --------------------------------------
+
+@pytest.mark.parametrize("body,frag", [
+    (chat_body(n=0), "n must be"),
+    (chat_body(n=99), "n must be"),
+    (chat_body(seed="abc"), "seed must be"),
+    (chat_body(logprobs=3), "logprobs must be a boolean"),
+    (chat_body(top_logprobs=5), "top_logprobs requires"),
+    (chat_body(logprobs=True, top_logprobs=5), "top_logprobs > 0 is not supported"),
+    (chat_body(temperature=9.0), "temperature must be in"),
+])
+def test_chat_validation_errors(body, frag):
+    with pytest.raises(oai.RequestError, match=frag):
+        oai.validate_chat_request(body)
+
+
+def test_completion_validation():
+    ok = {"model": "m", "prompt": "hi", "n": 2, "seed": 7, "logprobs": 2}
+    assert oai.validate_completion_request(ok) is ok
+    with pytest.raises(oai.RequestError, match="logprobs must be an integer"):
+        oai.validate_completion_request({"model": "m", "prompt": "hi", "logprobs": 9})
+
+
+async def test_validation_error_http_shape():
+    service, engine = await make_local_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json=chat_body(n=99),
+            ) as r:
+                assert r.status == 400
+                err = (await r.json())["error"]
+                assert err["type"] == "invalid_request_error" and "n must be" in err["message"]
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+# --- seed -------------------------------------------------------------------
+
+async def test_seed_reproducible_and_batch_independent():
+    """Same seed ⇒ same completion; different seed ⇒ (almost surely)
+    different. Sampling temperature high enough to make collisions unlikely."""
+    service, engine = await make_local_service()
+    url_tmpl = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async def run(seed):
+                async with s.post(url_tmpl, json=chat_body(
+                        temperature=1.5, seed=seed, max_tokens=12)) as r:
+                    assert r.status == 200
+                    return (await r.json())["choices"][0]["message"]["content"]
+
+            a1 = await run(1234)
+            a2 = await run(1234)
+            b = await run(99)
+            assert a1 == a2, "same seed must reproduce"
+            assert a1 != b, "different seeds should diverge"
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+# --- logprobs ---------------------------------------------------------------
+
+async def test_chat_logprobs_unary_and_stream():
+    service, engine = await make_local_service()
+    base = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base, json=chat_body(logprobs=True)) as r:
+                assert r.status == 200
+                choice = (await r.json())["choices"][0]
+                content = choice["logprobs"]["content"]
+                assert len(content) >= 1
+                assert all(e["logprob"] <= 0.0 for e in content)
+
+            async with s.post(base, json=chat_body(logprobs=True, stream=True)) as r:
+                assert r.status == 200
+                lp_entries = 0
+                async for line in r.content:
+                    if not line.startswith(b"data:") or b"[DONE]" in line:
+                        continue
+                    chunk = json.loads(line[5:])
+                    lp = chunk["choices"][0].get("logprobs")
+                    if lp:
+                        lp_entries += len(lp["content"])
+                        assert all(e["logprob"] <= 0.0 for e in lp["content"])
+                assert lp_entries >= 1
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+# --- n > 1 ------------------------------------------------------------------
+
+async def test_n_choices_unary_and_stream():
+    service, engine = await make_local_service()
+    base = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base, json=chat_body(n=3, temperature=1.5, seed=5)) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+                texts = [c["message"]["content"] for c in data["choices"]]
+                assert all(isinstance(t, str) and t for t in texts)
+                # Seeded choices use seed+i: not all identical (overwhelmingly).
+                assert len(set(texts)) > 1
+
+            async with s.post(base, json=chat_body(n=2, stream=True)) as r:
+                assert r.status == 200
+                seen = {0: 0, 1: 0}
+                finishes = set()
+                async for line in r.content:
+                    if not line.startswith(b"data:") or b"[DONE]" in line:
+                        continue
+                    chunk = json.loads(line[5:])
+                    ch = chunk["choices"][0]
+                    if ch["delta"].get("content"):
+                        seen[ch["index"]] += 1
+                    if ch.get("finish_reason"):
+                        finishes.add(ch["index"])
+                assert seen[0] > 0 and seen[1] > 0
+                assert finishes == {0, 1}
+    finally:
+        await service.stop()
+        await engine.stop()
