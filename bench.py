@@ -285,6 +285,28 @@ def child_main() -> None:
         except Exception as e:  # noqa: BLE001 — a failed point must not kill the sweep
             errors.append(f"decode b{batch}: {type(e).__name__}: {e}")
 
+    # --- int8 KV point (capacity ×2; opt-in — decode latency is at best at
+    # parity on current XLA:TPU, see models/llama.py:_gather_kv) -------------
+    if os.environ.get("BENCH_INT8") == "1" and not cpu_fallback and decode_points and remaining() > 90:
+        try:
+            b8 = batches[0]
+            cfg8 = cfg.replace(kv_cache_dtype="int8", attention_impl="gather")
+            step_s = bench_decode(cfg8, params, b8, ctx_len, steps, window)
+            kv_bytes = cfg.num_layers * ctx_len * cfg.num_kv_heads * cfg.head_dim * 2 * b8  # int8 k+v
+            gbps = (pbytes + kv_bytes) / step_s / 1e9
+            point = {
+                "batch": b8, "ctx": ctx_len, "kv_dtype": "int8",
+                "step_ms": round(step_s * 1000, 3),
+                "tok_s_per_user": round(1.0 / step_s, 2),
+                "tok_s_per_chip": round(b8 / step_s, 1),
+                "achieved_hbm_gbps": round(gbps, 1),
+                "pct_hbm_roofline": round(100 * gbps / hbm_gbps, 1) if hbm_gbps else None,
+            }
+            decode_points.append(point)
+            _emit_partial("decode_point", point)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"decode int8: {type(e).__name__}: {e}")
+
     # --- prefill ------------------------------------------------------------
     prefill_detail = None
     if remaining() > 45:

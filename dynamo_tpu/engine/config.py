@@ -62,6 +62,14 @@ class ModelConfig:
     # kernel on TPU and the XLA gather path elsewhere; "gather"/"paged_kernel"
     # force one. (Static: picked at trace time, one executable per choice.)
     attention_impl: str = "auto"
+    # KV cache storage dtype: "auto" follows the compute dtype; "int8" stores
+    # quantized KV (per-token-per-head symmetric scale) — halves KV memory,
+    # i.e. double the block capacity per HBM byte (longer contexts, bigger
+    # batches before preemption). Decode latency is NOT improved on current
+    # XLA:TPU (the int8 gather widens bytes internally — measured).
+    # Llama-family gather path only (MLA latents and the Pallas kernel read
+    # raw rows). Ref role: the engines' --kv-cache-dtype fp8 levers.
+    kv_cache_dtype: str = "auto"
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "gather", "paged_kernel"):
@@ -72,6 +80,13 @@ class ModelConfig:
             raise ValueError(
                 f"moe_dispatch must be auto|dense|ragged|capacity, got {self.moe_dispatch!r}"
             )
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(f"kv_cache_dtype must be auto|int8, got {self.kv_cache_dtype!r}")
+        if self.kv_cache_dtype == "int8":
+            if self.architecture == "mla":
+                raise ValueError("kv_cache_dtype=int8 is not supported for MLA latent caches")
+            if self.attention_impl == "paged_kernel":
+                raise ValueError("kv_cache_dtype=int8 requires the gather attention path")
 
     @property
     def q_size(self) -> int:

@@ -63,6 +63,8 @@ class EngineArgs:
     draft_model: Optional[str] = None
     draft_checkpoint_path: Optional[str] = None
     spec_gamma: int = 4
+    # KV cache storage dtype override ("auto" | "int8") — config.py.
+    kv_cache_dtype: str = "auto"
 
 
 class TpuEngine:
@@ -91,6 +93,8 @@ class TpuEngine:
         kv_event_sink: Optional[Callable[[KvEvent], None]] = None,
     ) -> "TpuEngine":
         mc = args.model_config or get_config(args.model)
+        if args.kv_cache_dtype != "auto":
+            mc = mc.replace(kv_cache_dtype=args.kv_cache_dtype)
         dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
         if params is None:
             if args.checkpoint_path:

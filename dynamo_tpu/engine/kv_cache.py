@@ -22,7 +22,7 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +31,52 @@ from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.llm.tokens import BlockHash
 
 
+class QuantKv(NamedTuple):
+    """int8-quantized KV tensor: values + per-(token, head) symmetric scale.
+
+    A pytree, so it flows through jit args, scan xs, and donation exactly
+    like a plain array — model code dispatches on the type at gather/scatter
+    points (``dequantize_kv`` / ``quantize_kv_rows``)."""
+
+    q: jax.Array  # int8, [L, N, BS, KVH, HD]
+    scale: jax.Array  # f32, [L, N, BS, KVH, 1]
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def reshape(self, *shape) -> "QuantKv":
+        # Layer-flat views ([L*N, ...]) reshape both members coherently.
+        return QuantKv(self.q.reshape(*shape), self.scale.reshape(*shape[:-1], 1))
+
+
+def quantize_kv_rows(rows: jax.Array) -> QuantKv:
+    """Symmetric int8 quantization over the trailing (head_dim) axis."""
+    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(rows.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QuantKv(q, scale)
+
+
+def dequantize_kv(x, dtype=jnp.bfloat16):
+    """QuantKv → real-valued rows; plain arrays pass through."""
+    if isinstance(x, QuantKv):
+        return (x.q.astype(jnp.float32) * x.scale).astype(dtype)
+    return x
+
+
 @dataclass
 class KvCacheArrays:
-    """Device-side block pool (one array pair covering all layers)."""
+    """Device-side block pool (one array pair covering all layers). With
+    ``config.kv_cache_dtype == "int8"`` the members are :class:`QuantKv`
+    pytrees instead of plain arrays."""
 
-    k: jax.Array  # [L, N, BS, KVH, HD]
-    v: jax.Array  # [L, N, BS, KVH, HD]
+    k: Any  # jax.Array | QuantKv — [L, N, BS, KVH, HD]
+    v: Any
 
     @classmethod
     def create(
@@ -57,10 +97,19 @@ class KvCacheArrays:
                 k = jax.device_put(k, sharding)
             return cls(k=k, v=jnp.zeros((config.num_layers, 1, 1, 1, 1), dtype=dtype))
         shape = (config.num_layers, num_blocks, config.block_size, config.num_kv_heads, config.head_dim)
-        init = jnp.zeros(shape, dtype=dtype)
-        if sharding is not None:
-            init = jax.device_put(init, sharding)
-        return cls(k=init, v=jnp.copy(init) if sharding is None else jax.device_put(jnp.zeros(shape, dtype=dtype), sharding))
+
+        def mk():
+            if config.kv_cache_dtype == "int8":
+                q = jnp.zeros(shape, dtype=jnp.int8)
+                scale = jnp.zeros((*shape[:-1], 1), dtype=jnp.float32)
+                if sharding is not None:
+                    q = jax.device_put(q, sharding)
+                    scale = jax.device_put(scale, sharding)
+                return QuantKv(q, scale)
+            init = jnp.zeros(shape, dtype=dtype)
+            return jax.device_put(init, sharding) if sharding is not None else init
+
+        return cls(k=mk(), v=mk())
 
 
 class OutOfBlocksError(Exception):

@@ -26,8 +26,37 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.kv_cache import QuantKv, quantize_kv_rows
 
 Params = Dict[str, jax.Array]
+
+
+def _gather_kv(flat, idx, dtype):
+    """Gather KV rows through a block-table index; int8 caches dequantize on
+    the way out (per-token-per-head symmetric scale).
+
+    Dequant runs directly in the compute dtype — an f32 intermediate would
+    double the materialized bytes (int8 codes are ≤7 bits of mantissa,
+    safely inside bf16). Note: on current XLA:TPU the int8 gather itself
+    does not run faster than bf16 (measured: parity at b8, slower at wide
+    batch — the gather widens byte elements internally), so int8 KV is a
+    CAPACITY feature (double the blocks per HBM byte — longer contexts,
+    bigger batches before preemption), not a decode-latency one."""
+    if isinstance(flat, QuantKv):
+        return flat.q[idx].astype(dtype) * flat.scale[idx].astype(dtype)
+    return flat[idx]
+
+
+def _scatter_kv(cache, layer_idx, blocks, offs, rows):
+    """Scatter fresh KV rows into the cache; int8 caches quantize on the way
+    in (requantization is stable to within one code step)."""
+    if isinstance(cache, QuantKv):
+        qk = quantize_kv_rows(rows)
+        return QuantKv(
+            cache.q.at[layer_idx, blocks, offs].set(qk.q),
+            cache.scale.at[layer_idx, blocks, offs].set(qk.scale),
+        )
+    return cache.at[layer_idx, blocks, offs].set(rows)
 
 
 # ---------------------------------------------------------------------------
@@ -330,8 +359,8 @@ def prefill(
         k = apply_rope(k, positions, c.rope_theta)
 
         table_l = block_table + l * N
-        k_ctx = k_flat[table_l].reshape(ctx, c.num_kv_heads, c.head_dim)
-        v_ctx = v_flat[table_l].reshape(ctx, c.num_kv_heads, c.head_dim)
+        k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
+        v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
         attn = _attend(
             q,
             jnp.concatenate([k_ctx, k], axis=0),
@@ -351,8 +380,8 @@ def prefill(
 
     # One all-layer scatter: [L, T] targets into the donated cache buffers.
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(k_rows)
-    v_new = v_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(v_rows)
+    k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], k_rows)
+    v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], v_rows)
 
     head = params.get("lm_head")
     if all_logits:
@@ -452,8 +481,8 @@ def decode_multi(
     )  # [w, B] — inactive rows sink to scratch block 0
     tgt_offs = slots % bs
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, num_steps, B))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(k_win)
-    v_new = v_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(v_win)
+    k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None], tgt_offs[None], k_win)
+    v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None], tgt_offs[None], v_win)
     return out, k_new, v_new
 
 
@@ -539,8 +568,8 @@ def _decode_layer_scan_window(
             # Two-piece attention merged with online-softmax weights: no
             # concat with the gathered prefix (a concat re-materializes the
             # [B, ctx] buffer — measured +5 ms/step at b32/1B on v5e).
-            k_ctx = k_flat[tables_l].reshape(B, ctx, kvh, hd)
-            v_ctx = v_flat[tables_l].reshape(B, ctx, kvh, hd)
+            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
             m1, l1, acc1 = piece(qg, k_ctx, v_ctx, mask0)
             k_small = jnp.concatenate([jnp.swapaxes(kwl, 0, 1), k[:, None]], axis=1)  # [B, w+1, ...]
             v_small = jnp.concatenate([jnp.swapaxes(vwl, 0, 1), v[:, None]], axis=1)
@@ -637,8 +666,8 @@ def chunk_decode(
         qg = q.reshape(B, S, kvh, G, hd)
 
         tables_l = block_tables + l * N
-        k_ctx = k_flat[tables_l].reshape(B, ctx, kvh, hd)
-        v_ctx = v_flat[tables_l].reshape(B, ctx, kvh, hd)
+        k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+        v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
         m1, l1, acc1 = piece(qg, k_ctx, v_ctx, prefix_mask)
         m2, l2, acc2 = piece(qg, k, v, chunk_mask)
         m_t = jnp.maximum(m1, m2)
@@ -670,8 +699,8 @@ def chunk_decode(
     tgt_offs = slots % bs
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, B, S))
     # k_rows: [L, B, S, KVH, HD]
-    k_new = k_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(k_rows)
-    v_new = v_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None]].set(v_rows)
+    k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None], tgt_offs[None], k_rows)
+    v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None], tgt_offs[None], v_rows)
 
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -798,8 +827,8 @@ def decode_layer_scan(
                 block_size=bs, interpret=jax.default_backend() != "tpu",
             )  # [B, H, hd]
         else:
-            k_ctx = k_flat[tables_l].reshape(B, ctx, c.num_kv_heads, c.head_dim)
-            v_ctx = v_flat[tables_l].reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, c.num_kv_heads, c.head_dim)
+            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, c.num_kv_heads, c.head_dim)
             k_full = jnp.concatenate([k_ctx, k[:, None]], axis=1)  # [B, ctx+1, KVH, hd]
             v_full = jnp.concatenate([v_ctx, v[:, None]], axis=1)
             mask_full = jnp.concatenate([mask, jnp.ones((B, 1), dtype=bool)], axis=1)
@@ -829,8 +858,8 @@ def scatter_kv_rows(
     """Single fused all-layer KV write (one scatter per cache tensor)."""
     L, B = k_rows.shape[0], k_rows.shape[1]
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(k_rows)
-    v_new = v_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :]].set(v_rows)
+    k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], k_rows)
+    v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], v_rows)
     return k_new, v_new
 
 

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.kv_cache import KvCacheArrays, QuantKv, quantize_kv_rows
 
 
 @jax.jit
@@ -35,8 +35,32 @@ def _has_v(cache: KvCacheArrays) -> bool:
     return cache.v.shape[1:] == cache.k.shape[1:]
 
 
+# int8 caches cross the transfer boundary as real-valued blocks: gather
+# dequantizes, scatter requantizes. Payload format (host numpy / device
+# stacks) is therefore identical for quantized and plain caches — KVBM
+# tiers and disagg pulls interoperate across workers with different
+# kv_cache_dtype settings. Requantizing a dequantized row recomputes the
+# same scale to float rounding, so round-trips are stable to within one
+# int8 code step.
+
+
+@jax.jit
+def _gather_one_quant(qkv: QuantKv, block_id: jax.Array) -> jax.Array:
+    return (qkv.q[:, block_id].astype(jnp.float32) * qkv.scale[:, block_id]).astype(jnp.float32)
+
+
+@jax.jit
+def _scatter_one_quant(qkv: QuantKv, block_id: jax.Array, rows: jax.Array) -> QuantKv:
+    qk = quantize_kv_rows(rows)
+    return QuantKv(qkv.q.at[:, block_id].set(qk.q), qkv.scale.at[:, block_id].set(qk.scale))
+
+
 def gather_blocks(cache: KvCacheArrays, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
     """Device block → host numpy (device_get performs the DMA)."""
+    if isinstance(cache.k, QuantKv):
+        k_dev = _gather_one_quant(cache.k, jnp.int32(block_id))
+        v_dev = _gather_one_quant(cache.v, jnp.int32(block_id))
+        return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
     if not _has_v(cache):
         k_dev = _gather_k(cache.k, jnp.int32(block_id))
         return np.asarray(jax.device_get(k_dev)), np.zeros((0,), dtype=cache.k.dtype)
@@ -46,6 +70,10 @@ def gather_blocks(cache: KvCacheArrays, block_id: int) -> Tuple[np.ndarray, np.n
 
 def scatter_blocks(cache: KvCacheArrays, block_id: int, k: np.ndarray, v: np.ndarray) -> None:
     """Host numpy → device block (in-place on the cache handle)."""
+    if isinstance(cache.k, QuantKv):
+        cache.k = _scatter_one_quant(cache.k, jnp.int32(block_id), jnp.asarray(k, dtype=jnp.float32))
+        cache.v = _scatter_one_quant(cache.v, jnp.int32(block_id), jnp.asarray(v, dtype=jnp.float32))
+        return
     if not _has_v(cache):
         cache.k = _scatter_k(cache.k, jnp.int32(block_id), jnp.asarray(k))
         return
@@ -80,11 +108,24 @@ def _scatter_many(cache: jax.Array, block_ids: jax.Array, blocks: jax.Array) -> 
     return cache.at[:, block_ids].set(blocks)
 
 
+@jax.jit
+def _gather_many_quant(qkv: QuantKv, block_ids: jax.Array) -> jax.Array:
+    return (qkv.q[:, block_ids].astype(jnp.float32) * qkv.scale[:, block_ids]).astype(jnp.bfloat16)
+
+
+@jax.jit
+def _scatter_many_quant(qkv: QuantKv, block_ids: jax.Array, blocks: jax.Array) -> QuantKv:
+    qk = quantize_kv_rows(blocks)
+    return QuantKv(qkv.q.at[:, block_ids].set(qk.q), qkv.scale.at[:, block_ids].set(qk.scale))
+
+
 def gather_blocks_device(cache: KvCacheArrays, block_ids) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Stack blocks into fresh device arrays (no host round-trip). The copy
     is independent of the cache, so the source blocks may be released
     immediately while the stack awaits a remote pull."""
     bids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+    if isinstance(cache.k, QuantKv):
+        return _gather_many_quant(cache.k, bids), _gather_many_quant(cache.v, bids)
     k = _gather_many(cache.k, bids)
     v = _gather_many(cache.v, bids) if _has_v(cache) else None
     return k, v
@@ -93,6 +134,11 @@ def gather_blocks_device(cache: KvCacheArrays, block_ids) -> Tuple[jax.Array, Op
 def scatter_blocks_device(cache: KvCacheArrays, block_ids, k_stack: jax.Array, v_stack) -> None:
     """Write stacked device blocks into the cache (in-place on the handle)."""
     bids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+    if isinstance(cache.k, QuantKv):
+        cache.k = _scatter_many_quant(cache.k, bids, k_stack)
+        if v_stack is not None:
+            cache.v = _scatter_many_quant(cache.v, bids, v_stack)
+        return
     cache.k = _scatter_many(cache.k, bids, k_stack)
     if v_stack is not None and _has_v(cache):
         cache.v = _scatter_many(cache.v, bids, v_stack)
@@ -109,6 +155,17 @@ def copy_blocks_between(src: KvCacheArrays, src_ids, dst: KvCacheArrays, dst_ids
     (ref: NIXL NVLink same-node transfers, dynamo_flow.md S8-S10)."""
     s = jnp.asarray(list(src_ids), dtype=jnp.int32)
     d = jnp.asarray(list(dst_ids), dtype=jnp.int32)
+    src_q = isinstance(src.k, QuantKv)
+    dst_q = isinstance(dst.k, QuantKv)
+    if src_q and dst_q:
+        # Quantized→quantized: move codes + scales directly, no requant.
+        dst.k = QuantKv(dst.k.q.at[:, d].set(src.k.q[:, s]), dst.k.scale.at[:, d].set(src.k.scale[:, s]))
+        dst.v = QuantKv(dst.v.q.at[:, d].set(src.v.q[:, s]), dst.v.scale.at[:, d].set(src.v.scale[:, s]))
+        return
+    if src_q or dst_q:
+        k_stack, v_stack = gather_blocks_device(src, list(src_ids))
+        scatter_blocks_device(dst, list(dst_ids), k_stack, v_stack)
+        return
     if _has_v(src):
         dst.k, dst.v = _copy_between(src.k, src.v, dst.k, dst.v, s, d)
     else:

@@ -41,6 +41,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-running", type=int, default=16)
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kv-cache-dtype", choices=["auto", "int8"], default="auto",
+                   help="int8 halves KV memory/bytes (llama gather path)")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--draft-model", default=None,
@@ -109,6 +111,7 @@ async def amain(args) -> None:
                 draft_model=args.draft_model,
                 draft_checkpoint_path=args.draft_checkpoint,
                 spec_gamma=args.spec_gamma,
+                kv_cache_dtype=args.kv_cache_dtype,
             )
         )
         if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
