@@ -25,6 +25,8 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--image", default="dynamo-tpu:latest")
     r.add_argument("--tpu-accelerator", default=None, help="GKE node selector value")
     r.add_argument("--tpu-topology", default=None)
+    c = sub.add_parser("cluster", help="render the DynamoGraphDeployment CRD + CR")
+    c.add_argument("graph", help="graph deployment YAML path")
     u = sub.add_parser("run", help="supervise the graph locally")
     u.add_argument("graph", help="graph deployment YAML path")
     u.add_argument("--interval", type=float, default=1.0, help="reconcile interval seconds")
@@ -59,6 +61,14 @@ def main() -> None:
                 tpu_topology=args.tpu_topology,
             ))
         except BrokenPipeError:  # e.g. piped into head
+            pass
+        return
+    if args.cmd == "cluster":
+        from dynamo_tpu.deploy.crd import render_cluster_yaml
+
+        try:
+            print(render_cluster_yaml(graph))
+        except BrokenPipeError:
             pass
         return
     init_logging()

@@ -1,0 +1,153 @@
+"""DynamoGraphDeployment CRD: schema + custom-resource round-trip.
+
+Ref: deploy/cloud/operator/api/v1alpha1 (DynamoGraphDeployment /
+DynamoComponentDeployment Go types). The reference ships a ~17k-LoC Go
+operator; the TPU build keeps the cluster contract — the CRD schema and
+the CR shape — declarative and language-neutral:
+
+- :func:`crd_manifest` emits the CustomResourceDefinition (openAPIV3Schema
+  validating the graph spec) for ``kubectl apply``.
+- :func:`graph_to_cr` / :func:`cr_to_graph` convert between the local
+  :class:`GraphDeployment` spec and the cluster CR, so a graph tested with
+  the local process operator (operator.py) deploys unchanged.
+- The planner's :class:`~dynamo_tpu.planner.connectors.KubernetesConnector`
+  scales either the CR's per-service replicas (an in-cluster controller
+  reconciles) or the rendered Deployments directly (manifests.py path,
+  no controller needed).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import yaml
+
+from dynamo_tpu.deploy.spec import GraphDeployment, ResourceSpec, ServiceSpec
+
+GROUP = "dynamo.tpu.io"
+VERSION = "v1alpha1"
+KIND = "DynamoGraphDeployment"
+PLURAL = "dynamographdeployments"
+
+
+def crd_manifest() -> dict:
+    """CustomResourceDefinition for DynamoGraphDeployment."""
+    service_schema = {
+        "type": "object",
+        "required": ["command"],
+        "properties": {
+            "command": {"type": "array", "items": {"type": "string"}},
+            "replicas": {"type": "integer", "minimum": 0, "default": 1},
+            "env": {"type": "object", "additionalProperties": {"type": "string"}},
+            "resources": {
+                "type": "object",
+                "properties": {
+                    "tpu_chips": {"type": "integer", "minimum": 0},
+                    "cpu": {"type": "string"},
+                    "memory": {"type": "string"},
+                },
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "plural": PLURAL,
+                "singular": "dynamographdeployment",
+                "shortNames": ["dgd"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "required": ["services"],
+                                    "properties": {
+                                        "control_plane": {"type": "string"},
+                                        "services": {
+                                            "type": "object",
+                                            "additionalProperties": service_schema,
+                                        },
+                                    },
+                                },
+                                "status": {
+                                    "type": "object",
+                                    "properties": {
+                                        "phase": {"type": "string"},
+                                        "ready_replicas": {
+                                            "type": "object",
+                                            "additionalProperties": {"type": "integer"},
+                                        },
+                                    },
+                                },
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def graph_to_cr(graph: GraphDeployment) -> dict:
+    """GraphDeployment spec → DynamoGraphDeployment custom resource."""
+    services = {}
+    for svc in graph.services.values():
+        services[svc.name] = {
+            "command": list(svc.command),
+            "replicas": svc.replicas,
+            "env": dict(svc.env),
+            "resources": {
+                "tpu_chips": svc.resources.tpu_chips,
+                "cpu": svc.resources.cpu,
+                "memory": svc.resources.memory,
+            },
+        }
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": KIND,
+        "metadata": {"name": graph.name, "namespace": graph.namespace},
+        "spec": {"control_plane": graph.control_plane or "", "services": services},
+    }
+
+
+def cr_to_graph(cr: dict) -> GraphDeployment:
+    """DynamoGraphDeployment CR → GraphDeployment (inverse of graph_to_cr)."""
+    if cr.get("kind") != KIND:
+        raise ValueError(f"not a {KIND}: kind={cr.get('kind')!r}")
+    meta = cr.get("metadata") or {}
+    spec = cr.get("spec") or {}
+    services = {}
+    for name, s in (spec.get("services") or {}).items():
+        services[name] = ServiceSpec(
+            name=name,
+            command=list(s.get("command") or []),
+            replicas=int(s.get("replicas", 1)),
+            env=dict(s.get("env") or {}),
+            resources=ResourceSpec.from_dict(s.get("resources")),
+        )
+    return GraphDeployment(
+        name=meta.get("name", "graph"),
+        namespace=meta.get("namespace", "default"),
+        control_plane=spec.get("control_plane") or "",
+        services=services,
+    )
+
+
+def render_cluster_yaml(graph: GraphDeployment) -> str:
+    """CRD + CR multi-document YAML (``kubectl apply -f -``)."""
+    docs: List[dict] = [crd_manifest(), graph_to_cr(graph)]
+    return "\n---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)

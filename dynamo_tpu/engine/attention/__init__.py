@@ -1,2 +1,6 @@
-"""Attention implementations: XLA paged gather (default), ring attention for
-sequence/context parallelism, Pallas kernels for TPU hot paths."""
+"""Attention implementations: the Pallas flash prefill kernel (prefill.py —
+40.8 TF/s causal at 1B shapes on v5e), ring attention for sequence/context
+parallelism (ring.py), and the XLA width-bucketed gather for paged decode
+(models/llama.py). A Pallas paged-DMA decode kernel lived here until r4;
+it was deleted after measuring 3-6× slower than the gather in every regime
+— ModelConfig.attention_impl records the numbers."""

@@ -58,35 +58,48 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
-    # Decode attention implementation: "auto" uses the Pallas paged-attention
-    # kernel on TPU and the XLA gather path elsewhere; "gather"/"paged_kernel"
-    # force one. (Static: picked at trace time, one executable per choice.)
+    # Decode attention implementation. "auto" == "gather": the XLA
+    # width-bucketed gather with two-piece online-softmax merge. A Pallas
+    # paged-DMA decode kernel was built and DELETED in r4 after honest
+    # measurement (tools/bench_decode_impl.py): two designs (per-sequence
+    # grid; flat cross-sequence pipelined DMA with per-row kv-len-bounded
+    # strips) both lost 3-6× to the gather at b8-b32/ctx1024-4k — per-page
+    # 16-64KB DMAs cost ~0.6-2.7 µs serialized on v5e and never overlap,
+    # while XLA's gather sustains 370-560 GB/s; even an extreme ragged batch
+    # (1×4K + 31×256 ctx, 11× fewer real bytes for the kernel) still lost
+    # (0.995 vs 0.740 ms/layer). Crossover needs >27× bucket-to-real-bytes
+    # raggedness — no realistic batch. jax's own tuned ragged_paged_attention
+    # rejects these head_dim=64 shapes outright.
     attention_impl: str = "auto"
+    # Prefill chunk attention: "auto" = Pallas flash kernel on TPU
+    # (attention/prefill.py — 40.8 TFLOP/s causal vs ~2 for the two-piece
+    # XLA path at 1B shapes on v5e), XLA path elsewhere; "flash"/"xla"
+    # force one ("flash" off-TPU runs the kernel interpreted — tests only).
+    prefill_impl: str = "auto"
     # KV cache storage dtype: "auto" follows the compute dtype; "int8" stores
     # quantized KV (per-token-per-head symmetric scale) — halves KV memory,
     # i.e. double the block capacity per HBM byte (longer contexts, bigger
     # batches before preemption). Decode latency is NOT improved on current
     # XLA:TPU (the int8 gather widens bytes internally — measured).
-    # Llama-family gather path only (MLA latents and the Pallas kernel read
-    # raw rows). Ref role: the engines' --kv-cache-dtype fp8 levers.
+    # Covers llama KV and MLA latent rows (per-token scale over the latent).
+    # Ref role: the engines' --kv-cache-dtype fp8 levers.
     kv_cache_dtype: str = "auto"
 
     def __post_init__(self):
-        if self.attention_impl not in ("auto", "gather", "paged_kernel"):
+        if self.attention_impl not in ("auto", "gather"):
             raise ValueError(
-                f"attention_impl must be auto|gather|paged_kernel, got {self.attention_impl!r}"
+                f"attention_impl must be auto|gather, got {self.attention_impl!r} "
+                "(the Pallas paged decode kernel was removed after losing to the "
+                "gather in every measured regime — see attention_impl docs)"
             )
+        if self.prefill_impl not in ("auto", "flash", "xla"):
+            raise ValueError(f"prefill_impl must be auto|flash|xla, got {self.prefill_impl!r}")
         if self.moe_dispatch not in ("auto", "dense", "ragged", "capacity"):
             raise ValueError(
                 f"moe_dispatch must be auto|dense|ragged|capacity, got {self.moe_dispatch!r}"
             )
         if self.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(f"kv_cache_dtype must be auto|int8, got {self.kv_cache_dtype!r}")
-        if self.kv_cache_dtype == "int8":
-            if self.architecture == "mla":
-                raise ValueError("kv_cache_dtype=int8 is not supported for MLA latent caches")
-            if self.attention_impl == "paged_kernel":
-                raise ValueError("kv_cache_dtype=int8 requires the gather attention path")
 
     @property
     def q_size(self) -> int:

@@ -65,6 +65,11 @@ class EngineArgs:
     spec_gamma: int = 4
     # KV cache storage dtype override ("auto" | "int8") — config.py.
     kv_cache_dtype: str = "auto"
+    # Precompile serving-hot executables for contexts up to this many tokens
+    # before taking traffic (scheduler.warmup; 0 = skip). Without it, every
+    # new (batch bucket × table width) shape compiles mid-request — measured
+    # as the dominant serving-plane latency on fresh processes.
+    warmup_ctx: int = 0
 
 
 class TpuEngine:
@@ -140,6 +145,9 @@ class TpuEngine:
                         dc, jax.random.PRNGKey(args.seed + 1), dtype=dtype
                     )
             engine.scheduler.attach_draft(dc, draft_params, gamma=args.spec_gamma)
+        if args.warmup_ctx > 0:
+            n = engine.scheduler.warmup(args.warmup_ctx)
+            logger.info("warmed %d executables (ctx %d)", n, args.warmup_ctx)
         if args.kvbm_host_blocks > 0:
             from dynamo_tpu.llm.block_manager import KvBlockManager
 
@@ -223,6 +231,8 @@ class TpuEngine:
             top_p=float(sampling_d.get("top_p") or 1.0),
             seed=int(seed) if seed is not None else None,
             logprobs=bool(sampling_d.get("logprobs")),
+            frequency_penalty=float(sampling_d.get("frequency_penalty") or 0.0),
+            presence_penalty=float(sampling_d.get("presence_penalty") or 0.0),
         )
         stop = StopConditions.from_dict(request.get("stop_conditions"))
         disagg = request.get("disagg_params") or {}
@@ -232,6 +242,13 @@ class TpuEngine:
             "keep_blocks_on_finish": bool(disagg.get("do_remote_decode")),
             "prefilled": request.get("_prefilled"),
         }
+        mm = request.get("multimodal")
+        if mm is not None:
+            from dynamo_tpu.llm.multimodal import features_from_wire
+
+            extras["mm_features"] = (
+                mm if hasattr(mm, "shape") else features_from_wire(mm)
+            )
         queue: "asyncio.Queue[StepOutput]" = asyncio.Queue()
         self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue, extras))
         self._wake.set()
@@ -263,6 +280,8 @@ class TpuEngine:
                 }
                 if out.logprob is not None:
                     frame["logprobs"] = [out.logprob]
+                if out.queue_s is not None:
+                    frame["queue_s"] = out.queue_s
                 yield frame
                 if out.finished:
                     finished = True

@@ -89,9 +89,18 @@ class KvCacheArrays:
         if config.architecture == "mla":
             # MLA stores one shared latent row per token (kv_lora_rank +
             # rope dim) in ``k``; ``v`` is a placeholder (values decompress
-            # from the latent — models/mla.py).
+            # from the latent — models/mla.py). int8 quantizes the latent
+            # row with one per-token scale (the row is rms-normed latent ‖
+            # rope'd keys — O(1) ranges, one scale holds within a code step).
             width = config.kv_lora_rank + config.qk_rope_head_dim
             shape = (config.num_layers, num_blocks, config.block_size, 1, width)
+            if config.kv_cache_dtype == "int8":
+                q = jnp.zeros(shape, dtype=jnp.int8)
+                scale = jnp.zeros((*shape[:-1], 1), dtype=jnp.float32)
+                if sharding is not None:
+                    q = jax.device_put(q, sharding)
+                    scale = jax.device_put(scale, sharding)
+                return cls(k=QuantKv(q, scale), v=jnp.zeros((config.num_layers, 1, 1, 1, 1), dtype=dtype))
             k = jnp.zeros(shape, dtype=dtype)
             if sharding is not None:
                 k = jax.device_put(k, sharding)

@@ -227,8 +227,13 @@ def _moe_capacity(
         onehot = onehot * jnp.repeat(valid, K).astype(jnp.int32)[:, None]
     slot = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot, flat_e[:, None], axis=1)[:, 0]
     keep = slot < C
-    if valid is not None:
-        keep = keep & jnp.repeat(valid, K)
+    live = jnp.ones_like(keep) if valid is None else jnp.repeat(valid, K)
+    keep = keep & live
+    # Capacity-drop accounting: live assignments that lost the slot race
+    # (their token contributes only its residual). Exported per step via
+    # ForwardPassMetrics → Prometheus when moe_stats is requested (ref:
+    # wide-EP observability, SURVEY.md §2e).
+    dropped = jnp.sum(live & ~keep).astype(jnp.int32)
     slot_c = jnp.clip(slot, 0, C - 1)
     # (e, slot) pairs are unique among kept rows (cumsum), so .add == .set;
     # dropped rows add 0.
@@ -241,12 +246,17 @@ def _moe_capacity(
     u = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
     h = jax.nn.silu(g) * u
     ye = jnp.einsum("ecf,efd->ecd", h, lp["w_down"])
-    return jnp.einsum("ecd,ect->td", ye.astype(jnp.float32), comb).astype(x.dtype)
+    out = jnp.einsum("ecd,ect->td", ye.astype(jnp.float32), comb).astype(x.dtype)
+    return out, dropped
 
 
 def _mlp(
-    x: jax.Array, lp: Dict[str, jax.Array], config: ModelConfig, valid: Optional[jax.Array] = None
-) -> jax.Array:
+    x: jax.Array,
+    lp: Dict[str, jax.Array],
+    config: ModelConfig,
+    valid: Optional[jax.Array] = None,
+    stats: bool = False,
+):
     """Feed-forward block: dense SwiGLU, or MoE when config.num_experts > 0.
 
     MoE dispatch is selected by ``config.moe_dispatch`` (see config.py):
@@ -260,17 +270,50 @@ def _mlp(
 
     ``valid`` marks live rows (decode ``active`` lanes / prefill valid
     tokens); sparse dispatch excludes dead rows so they cannot consume
-    expert capacity meant for live tokens."""
+    expert capacity meant for live tokens.
+
+    With ``stats=True`` returns ``(out, dropped i32)`` — the number of live
+    (token, expert) assignments dropped by capacity pressure this call
+    (always 0 for exact dispatch modes)."""
     if config.num_experts == 0:
-        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        out = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return (out, jnp.int32(0)) if stats else out
     mode = config.moe_dispatch
     if mode == "auto":
         mode = "ragged"
     if mode == "dense":
-        return _moe_dense(x, lp, config)
+        out = _moe_dense(x, lp, config)
+        return (out, jnp.int32(0)) if stats else out
     if mode == "ragged":
-        return _moe_ragged(x, lp, config, valid)
-    return _moe_capacity(x, lp, config, valid)
+        out = _moe_ragged(x, lp, config, valid)
+        return (out, jnp.int32(0)) if stats else out
+    out, dropped = _moe_capacity(x, lp, config, valid)
+    return (out, dropped) if stats else out
+
+
+def _attend_piece(qg, kp, vp, maskp, scale):
+    """Partial decode attention over one KV piece → (m, l, acc) online-
+    softmax state. qg [B,KVH,G,hd]; kp/vp [B,S,KVH,hd]; maskp [B,S].
+    Shared by both decode backends: the Pallas paged kernel produces the
+    same partials for the cached prefix, so the pieces merge identically."""
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, kp).astype(jnp.float32) * scale
+    s = jnp.where(maskp[:, None, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1)  # [B,KVH,G]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgs,bskd->bkgd", p.astype(vp.dtype), vp).astype(jnp.float32)
+    return m, l, acc
+
+
+def _merge_pieces(m1, l1, acc1, m2, l2, acc2) -> jax.Array:
+    """Close the online softmax across two attention pieces → [B,KVH,G,hd]
+    f32 (caller casts). All-masked pieces (m = -inf, l = 0) drop out."""
+    m_t = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m_t)
+    a2 = jnp.exp(m2 - m_t)
+    l_t = l1 * a1 + l2 * a2
+    acc = acc1 * a1[..., None] + acc2 * a2[..., None]
+    return acc / jnp.maximum(l_t, 1e-30)[..., None]
 
 
 def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, config: ModelConfig) -> jax.Array:
@@ -304,12 +347,24 @@ def prefill(
     tokens: jax.Array,  # [T] bucket-padded token ids
     valid_len: jax.Array,  # scalar: actual new tokens
     cache_len: jax.Array,  # scalar: tokens already in the block table (prefix reuse / chunked prefill)
-    block_table: jax.Array,  # [max_blocks] block ids (0 = scratch)
+    block_table: jax.Array,  # [W] block ids (0 = scratch); W bucketed by the caller
     all_logits: bool = False,  # static: return logits for every position [T, V]
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    use_flash: bool = False,  # static: Pallas flash kernel for chunk attention
+    has_prefix: bool = True,  # static: False ⇒ cache_len == 0, skip the prefix piece
+    mm_feats: Optional[jax.Array] = None,  # [F, D] multimodal feature rows
+    mm_len: Optional[jax.Array] = None,  # scalar i32: valid feature rows
+    moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+) -> Tuple[jax.Array, ...]:
     """One prefill (or prefill chunk). Returns (last_logits [V], k_cache,
     v_cache) — or ([T, V] logits with ``all_logits=True``, the target-model
-    verification pass for speculative decoding; spec_decode.py)."""
+    verification pass for speculative decoding; spec_decode.py).
+
+    With ``use_flash`` the chunk's causal self-attention runs in the Pallas
+    flash kernel (attention/prefill.py — scores never leave VMEM) and the
+    cached-prefix piece (absent for fresh prefills: ``has_prefix=False``)
+    is an online-softmax partial merged outside the kernel. The XLA path
+    (use_flash=False) materializes the full [T, ctx+T] mask — CPU meshes /
+    debugging."""
     c = config
     bs = c.block_size
     T = tokens.shape[0]
@@ -318,6 +373,14 @@ def prefill(
     h = params["embed"].at[tokens].get(mode="clip")  # [T, D]
     positions = cache_len + jnp.arange(T, dtype=jnp.int32)
     valid_q = jnp.arange(T, dtype=jnp.int32) < valid_len
+    if mm_feats is not None:
+        # Multimodal early fusion: positions [0, mm_len) are image-feature
+        # rows (vision-prefix); override their token embeddings with the
+        # encoder's projected features (ref role: trtllm encode_helper.py —
+        # the encode worker hands features to prefill).
+        inject = (positions < mm_len) & valid_q
+        rows = mm_feats.at[jnp.clip(positions, 0, mm_feats.shape[0] - 1)].get(mode="clip")
+        h = jnp.where(inject[:, None], rows.astype(h.dtype), h)
 
     # Scatter targets for the new tokens; padded positions sink to block 0.
     slots = jnp.where(valid_q, positions, 0)
@@ -333,10 +396,14 @@ def prefill(
     # Prefix mask: cached key j visible iff j < cache_len. Chunk-internal
     # attention is causal within the chunk.
     key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))  # [T, ctx]
     chunk_q = jnp.arange(T, dtype=jnp.int32)
-    chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]  # [T, T]
-    mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
+    if not use_flash:
+        prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))  # [T, ctx]
+        chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]  # [T, T]
+        mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
+    interp = jax.default_backend() != "tpu"
+    scale = c.head_dim**-0.5
+    kvh, G = c.num_kv_heads, c.num_heads // c.num_kv_heads
 
     # Layer-flat cache view: gathering from [L*N, ...] with layer-offset
     # tables avoids the scan's per-layer dynamic-slice of the cache, which
@@ -358,25 +425,69 @@ def prefill(
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
-        table_l = block_table + l * N
-        k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
-        v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
-        attn = _attend(
-            q,
-            jnp.concatenate([k_ctx, k], axis=0),
-            jnp.concatenate([v_ctx, v], axis=0),
-            mask,
-            c,
-        )
+        if use_flash:
+            from dynamo_tpu.engine.attention.prefill import (
+                flash_chunk_attention,
+                merge_attention_pieces,
+            )
+
+            out2, m2, l2 = flash_chunk_attention(
+                q, k, v, valid_len, num_kv_heads=kvh, interpret=interp
+            )
+            if has_prefix:
+                # Cached-prefix partial (online-softmax state), merged with
+                # the kernel's chunk piece. The gather is bounded by the
+                # caller's width-bucketed table — the true prefix extent,
+                # not max_seq_len.
+                table_l = block_table + l * N
+                k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
+                v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
+                qg = q.reshape(T, kvh, G, c.head_dim)
+                s = jnp.einsum("tkgd,skd->ktgs", qg, k_ctx).astype(jnp.float32) * scale
+                s = jnp.where((key_pos < cache_len)[None, None, None, :], s, -1e30)
+                m1 = jnp.max(s, axis=-1)  # [KVH, T, G]
+                p = jnp.exp(s - m1[..., None])
+                l1 = jnp.sum(p, axis=-1)
+                acc1 = jnp.einsum("ktgs,skd->ktgd", p.astype(v_ctx.dtype), v_ctx).astype(
+                    jnp.float32
+                )
+                attn = merge_attention_pieces(out2, m2, l2, m1, l1, acc1)
+            else:
+                attn = out2
+        else:
+            table_l = block_table + l * N
+            k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
+            v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
+            attn = _attend(
+                q,
+                jnp.concatenate([k_ctx, k], axis=0),
+                jnp.concatenate([v_ctx, v], axis=0),
+                mask,
+                c,
+            )
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        if moe_stats:
+            mlp_out, drops = _mlp(x, lp, c, valid=valid_q, stats=True)
+            h = h + mlp_out
+            return h, (k, v, drops)
         h = h + _mlp(x, lp, c, valid=valid_q)
         return h, (k, v)
 
-    h, (k_rows, v_rows) = lax.scan(
-        layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
-    )
+    if moe_stats:
+        h, (k_rows, v_rows, layer_drops) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
+        aux = {
+            "moe_dropped": jnp.sum(layer_drops),
+            "moe_assignments": jnp.sum(valid_q).astype(jnp.int32)
+            * jnp.int32(max(c.num_experts_per_tok, 1) * L),
+        }
+    else:
+        h, (k_rows, v_rows) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
 
     # One all-layer scatter: [L, T] targets into the donated cache buffers.
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
@@ -387,10 +498,12 @@ def prefill(
     if all_logits:
         h_all = rms_norm(h, params["final_norm"], c.rms_norm_eps)
         logits = h_all @ (head if head is not None else params["embed"].T)
-        return logits.astype(jnp.float32), k_new, v_new
-    last = jnp.maximum(valid_len - 1, 0)
-    h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
-    logits = h_last @ (head if head is not None else params["embed"].T)
+    else:
+        last = jnp.maximum(valid_len - 1, 0)
+        h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
+        logits = h_last @ (head if head is not None else params["embed"].T)
+    if moe_stats:
+        return logits.astype(jnp.float32), k_new, v_new, aux
     return logits.astype(jnp.float32), k_new, v_new
 
 
@@ -408,7 +521,14 @@ def decode_multi(
     top_ps: jax.Array,  # [B] f32 (1 = off)
     rng_key: jax.Array,
     num_steps: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+    return_logits: bool = False,  # static: also return per-step logits [steps, B, V]
+) -> Tuple[jax.Array, ...]:
+    if moe_stats and return_logits:
+        raise NotImplementedError(
+            "decode_multi: moe_stats and return_logits cannot be combined yet "
+            "(the return tuples would be ambiguous to existing unpackers)"
+        )
     """``num_steps`` autoregressive decode steps + on-device sampling in ONE
     compiled dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache).
 
@@ -434,27 +554,18 @@ def decode_multi(
     B = tokens.shape[0]
     L, KVH, HD = c.num_layers, c.num_kv_heads, c.head_dim
     bs = c.block_size
-    use_kernel = c.attention_impl == "paged_kernel"
-    if use_kernel and jax.default_backend() == "tpu" and not (
-        c.kv_size % 128 == 0 and c.block_size % 8 == 0
-    ):
-        raise ValueError(
-            f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
-            f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
-        )
 
     # Cached-prefix mask is fixed for the whole window (the cache is not
     # written during it); window rows carry the in-flight tokens.
     _, _, mask0 = decode_targets(positions, block_tables, active, bs)
-    kv_lens0 = jnp.where(active, positions, 0)  # cached tokens (kernel path)
 
     def body(i, state):
-        toks, k_win, v_win, out, key = state
+        toks, k_win, v_win, out, lg_out, key, drops = state
         poss = positions + i
         h = params["embed"].at[toks].get(mode="clip")  # [B, D]
-        h, k_rows, v_rows = _decode_layer_scan_window(
+        h, k_rows, v_rows, step_drops = _decode_layer_scan_window(
             params["layers"], c, k_cache, v_cache, h, poss, block_tables,
-            mask0, k_win, v_win, i, active, kv_lens0, use_kernel,
+            mask0, k_win, v_win, i, active, moe_stats=moe_stats,
         )
         k_win = k_win.at[:, i].set(k_rows)
         v_win = v_win.at[:, i].set(v_rows)
@@ -464,13 +575,23 @@ def decode_multi(
         key, sub = jax.random.split(key)
         nxt = sample_batch(logits, temps, top_ks, top_ps, sub).astype(jnp.int32)
         out = out.at[i].set(nxt)
-        return (nxt, k_win, v_win, out, key)
+        if return_logits:
+            lg_out = lg_out.at[i].set(logits)
+        return (nxt, k_win, v_win, out, lg_out, key, drops + step_drops)
 
-    k_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=k_cache.dtype)
-    v_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=v_cache.dtype)
+    # Window rows are IN-FLIGHT real values (compute dtype) — int8 caches
+    # only quantize at the final fused scatter. (cache.dtype would be int8
+    # for QuantKv: scattering f32 rows into it is an unsafe cast — a JAX
+    # FutureWarning today, an error in future releases — and would strip
+    # the scales.)
+    wdtype = params["embed"].dtype
+    k_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=wdtype)
+    v_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=wdtype)
     out0 = jnp.zeros((num_steps, B), dtype=jnp.int32)
-    _, k_win, v_win, out, _ = lax.fori_loop(
-        0, num_steps, body, (tokens, k_win0, v_win0, out0, rng_key)
+    V = params["embed"].shape[0]
+    lg0 = jnp.zeros((num_steps if return_logits else 1, B, V if return_logits else 1), jnp.float32)
+    _, k_win, v_win, out, lg_steps, _, total_drops = lax.fori_loop(
+        0, num_steps, body, (tokens, k_win0, v_win0, out0, lg0, rng_key, jnp.int32(0))
     )
 
     # One fused scatter for the whole window: row (l, j, b) → slot pos_b + j.
@@ -483,6 +604,15 @@ def decode_multi(
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, num_steps, B))
     k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None], tgt_offs[None], k_win)
     v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None], tgt_offs[None], v_win)
+    if moe_stats:
+        aux = {
+            "moe_dropped": total_drops,
+            "moe_assignments": jnp.sum(active).astype(jnp.int32)
+            * jnp.int32(max(c.num_experts_per_tok, 1) * L * num_steps),
+        }
+        return out, k_new, v_new, aux
+    if return_logits:
+        return out, lg_steps, k_new, v_new
     return out, k_new, v_new
 
 
@@ -499,15 +629,12 @@ def _decode_layer_scan_window(
     v_win: jax.Array,
     step: jax.Array,  # scalar i — window rows j < i are live
     active: jax.Array,  # [B] bool
-    kv_lens0: Optional[jax.Array] = None,  # [B] cached tokens (kernel path)
-    use_kernel: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    moe_stats: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Decode layer scan attending [cached prefix ; window rows ; current].
     Same math as ``decode_layer_scan`` — the window rows are exactly the
     tokens a per-step cache write would have placed at positions
-    pos0..pos0+i-1, read from the carry instead of the cache. The Pallas
-    kernel path streams the cached prefix HBM→VMEM (no gathered copy) and
-    folds [current ; window] rows in-register."""
+    pos0..pos0+i-1, read from the carry instead of the cache."""
     B = h.shape[0]
     bs = c.block_size
     ctx = block_tables.shape[1] * bs
@@ -529,17 +656,6 @@ def _decode_layer_scan_window(
         axis=1,
     )  # [B, w+1]
 
-    def piece(qg, kp, vp, maskp):
-        """Partial attention over one KV piece → (m, l, acc) online-softmax
-        state. qg [B,KVH,G,hd]; kp/vp [B,S,KVH,hd]; maskp [B,S]."""
-        s = jnp.einsum("bkgd,bskd->bkgs", qg, kp).astype(jnp.float32) * scale
-        s = jnp.where(maskp[:, None, None, :], s, -1e30)
-        m = jnp.max(s, axis=-1)  # [B,KVH,G]
-        p = jnp.exp(s - m[..., None])
-        l = jnp.sum(p, axis=-1)
-        acc = jnp.einsum("bkgs,bskd->bkgd", p.astype(vp.dtype), vp).astype(jnp.float32)
-        return m, l, acc
-
     def layer_fn(h, xs):
         lp, l, kwl, vwl = xs  # kwl/vwl: [w, B, KVH, HD] this layer's window rows
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
@@ -552,45 +668,34 @@ def _decode_layer_scan_window(
         qg = q.reshape(B, kvh, G, hd)
 
         tables_l = block_tables + l * N
-        if use_kernel:
-            from dynamo_tpu.engine.attention.paged import paged_decode_attention
-
-            # In-register rows: [current ; window] — valid prefix 1 + step.
-            k_reg = jnp.concatenate([k[:, None], jnp.swapaxes(kwl, 0, 1)], axis=1)
-            v_reg = jnp.concatenate([v[:, None], jnp.swapaxes(vwl, 0, 1)], axis=1)
-            attn = paged_decode_attention(
-                q, k_flat, v_flat, tables_l, kv_lens0,
-                k_cur=k_reg, v_cur=v_reg,
-                extra_valid=jnp.full((B,), 1 + step, dtype=jnp.int32),
-                block_size=bs, interpret=jax.default_backend() != "tpu",
-            ).reshape(B, kvh, G, hd)
-        else:
-            # Two-piece attention merged with online-softmax weights: no
-            # concat with the gathered prefix (a concat re-materializes the
-            # [B, ctx] buffer — measured +5 ms/step at b32/1B on v5e).
-            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-            m1, l1, acc1 = piece(qg, k_ctx, v_ctx, mask0)
-            k_small = jnp.concatenate([jnp.swapaxes(kwl, 0, 1), k[:, None]], axis=1)  # [B, w+1, ...]
-            v_small = jnp.concatenate([jnp.swapaxes(vwl, 0, 1), v[:, None]], axis=1)
-            m2, l2, acc2 = piece(qg, k_small, v_small, small_mask)
-
-            m_t = jnp.maximum(m1, m2)
-            a1 = jnp.exp(m1 - m_t)
-            a2 = jnp.exp(m2 - m_t)
-            l_t = l1 * a1 + l2 * a2
-            acc = acc1 * a1[..., None] + acc2 * a2[..., None]
-            attn = (acc / jnp.maximum(l_t, 1e-30)[..., None]).astype(h.dtype)  # [B,KVH,G,hd]
+        # Piece 1: cached prefix via the width-bucketed gather (two-piece
+        # online-softmax — no concat re-materialization of [B, ctx]).
+        k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+        v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+        m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask0, scale)
+        # Piece 2: in-register rows [window ; current] — never round-trip HBM.
+        k_small = jnp.concatenate([jnp.swapaxes(kwl, 0, 1), k[:, None]], axis=1)  # [B, w+1, ...]
+        v_small = jnp.concatenate([jnp.swapaxes(vwl, 0, 1), v[:, None]], axis=1)
+        m2, l2, acc2 = _attend_piece(qg, k_small, v_small, small_mask, scale)
+        attn = _merge_pieces(m1, l1, acc1, m2, l2, acc2).astype(h.dtype)
 
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        if moe_stats:
+            mlp_out, drops = _mlp(x, lp, c, valid=active, stats=True)
+            return h + mlp_out, (k, v, drops)
         h = h + _mlp(x, lp, c, valid=active)
         return h, (k, v)
 
+    if moe_stats:
+        h, (k_rows, v_rows, layer_drops) = lax.scan(
+            layer_fn, h, (layers, jnp.arange(L, dtype=jnp.int32), k_win, v_win)
+        )
+        return h, k_rows, v_rows, jnp.sum(layer_drops)
     h, (k_rows, v_rows) = lax.scan(
         layer_fn, h, (layers, jnp.arange(L, dtype=jnp.int32), k_win, v_win)
     )
-    return h, k_rows, v_rows
+    return h, k_rows, v_rows, jnp.int32(0)
 
 
 def chunk_decode(
@@ -602,10 +707,13 @@ def chunk_decode(
     positions0: jax.Array,  # [B] position of tokens[:, 0]
     valid: jax.Array,  # [B] valid tokens per row (0 = inactive row)
     block_tables: jax.Array,  # [B, W]
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    all_logits: bool = False,  # static: return logits [B, S, V] instead of argmax
+    moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+) -> Tuple[jax.Array, ...]:
     """Batched multi-token decode: each row consumes up to S tokens in ONE
     pass and yields the greedy next-token prediction after every consumed
-    position → (argmax tokens [B, S] i32, k_cache, v_cache).
+    position → (argmax tokens [B, S] i32, k_cache, v_cache) — or the full
+    per-position logits with ``all_logits=True``.
 
     This is the engine primitive behind batched speculative decoding
     (spec_decode.py; ref surfaces SpecDecodeStats, _core.pyi:354-427): the
@@ -681,13 +789,27 @@ def chunk_decode(
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
         valid_flat = (s_i[None, :] < valid[:, None]).reshape(B * S)
+        if moe_stats:
+            mlp_out, drops = _mlp(x.reshape(B * S, -1), lp, c, valid=valid_flat, stats=True)
+            h = h + mlp_out.reshape(B, S, -1)
+            return h, (k, v, drops)
         mlp_out = _mlp(x.reshape(B * S, -1), lp, c, valid=valid_flat).reshape(B, S, -1)
         h = h + mlp_out
         return h, (k, v)
 
-    h, (k_rows, v_rows) = lax.scan(
-        layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
-    )
+    if moe_stats:
+        h, (k_rows, v_rows, layer_drops) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
+        chunk_aux = {
+            "moe_dropped": jnp.sum(layer_drops),
+            "moe_assignments": jnp.sum(valid).astype(jnp.int32)
+            * jnp.int32(max(c.num_experts_per_tok, 1) * L),
+        }
+    else:
+        h, (k_rows, v_rows) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
 
     # Fused scatter of all chunk rows: slot (b, s) → positions0[b]+s when
     # s < valid[b], else the scratch sink (block 0 of each layer).
@@ -705,7 +827,15 @@ def chunk_decode(
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     logits = h @ (head if head is not None else params["embed"].T)  # [B, S, V]
+    if all_logits:
+        # Sampled speculative verification needs the full target
+        # distributions per position (spec_decode.spec_verify).
+        if moe_stats:
+            return logits.astype(jnp.float32), k_new, v_new, chunk_aux
+        return logits.astype(jnp.float32), k_new, v_new
     next_tokens = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    if moe_stats:
+        return next_tokens, k_new, v_new, chunk_aux
     return next_tokens, k_new, v_new
 
 
@@ -782,10 +912,9 @@ def decode_layer_scan(
     positions: jax.Array,  # [B]
     block_tables: jax.Array,  # [B, max_blocks]
     mask: jax.Array,  # [B, ctx] bool — cached prefix only (decode_targets)
-    kv_lens: Optional[jax.Array],  # [B] cached tokens per row (kernel path only)
-    use_kernel: bool,
     active: Optional[jax.Array] = None,  # [B] bool — live lanes (MoE dispatch mask)
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    moe_stats: bool = False,  # also return summed capacity drops
+):
     """Scan the decode layer body over a stacked layer group. Factored out of
     ``decode`` so pipeline parallelism (pipeline_parallel.py) can run the
     same body on each stage's local L/pp slice of layers + KV cache.
@@ -801,12 +930,14 @@ def decode_layer_scan(
     bs = c.block_size
     ctx = block_tables.shape[1] * bs
     # Layer-flat cache views (see prefill): no per-layer slice copies in the
-    # scan — gathers and the Pallas kernel index [L'*N, ...] with
-    # layer-offset tables instead.
+    # scan — gathers index [L'*N, ...] with layer-offset tables instead.
     Lp = k_cache.shape[0]
     N = k_cache.shape[1]
     k_flat = k_cache.reshape(Lp * N, bs, c.num_kv_heads, c.head_dim)
     v_flat = v_cache.reshape(Lp * N, bs, c.num_kv_heads, c.head_dim)
+
+    kvh, G, hd = c.num_kv_heads, c.num_heads // c.num_kv_heads, c.head_dim
+    scale = hd**-0.5
 
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index within this stack
@@ -817,30 +948,32 @@ def decode_layer_scan(
         q = apply_rope(q, positions[:, None], c.rope_theta)[:, 0]  # [B, H, hd]
         k = apply_rope(k, positions[:, None], c.rope_theta)[:, 0]  # [B, KVH, hd]
         v = v[:, 0]
+        qg = q.reshape(B, kvh, G, hd)
 
         tables_l = block_tables + l * N
-        if use_kernel:
-            from dynamo_tpu.engine.attention.paged import paged_decode_attention
-
-            attn = paged_decode_attention(
-                q, k_flat, v_flat, tables_l, kv_lens, k_cur=k, v_cur=v,
-                block_size=bs, interpret=jax.default_backend() != "tpu",
-            )  # [B, H, hd]
-        else:
-            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, c.num_kv_heads, c.head_dim)
-            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, c.num_kv_heads, c.head_dim)
-            k_full = jnp.concatenate([k_ctx, k[:, None]], axis=1)  # [B, ctx+1, KVH, hd]
-            v_full = jnp.concatenate([v_ctx, v[:, None]], axis=1)
-            mask_full = jnp.concatenate([mask, jnp.ones((B, 1), dtype=bool)], axis=1)
-            attn = jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], c)[0])(
-                q, k_full, v_full, mask_full
-            )  # [B, H, hd]
+        # Two online-softmax pieces: cached prefix (width-bucketed gather,
+        # no concat re-materialization) + the current token in-register.
+        k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+        v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+        m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask, scale)
+        m2, l2, acc2 = _attend_piece(
+            qg, k[:, None], v[:, None], jnp.ones((B, 1), dtype=bool), scale
+        )
+        attn = _merge_pieces(m1, l1, acc1, m2, l2, acc2).astype(h.dtype)
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        if moe_stats:
+            mlp_out, drops = _mlp(x, lp, c, valid=active, stats=True)
+            return h + mlp_out, (k, v, drops)
         h = h + _mlp(x, lp, c, valid=active)
         return h, (k, v)
 
+    if moe_stats:
+        h, (k_rows, v_rows, layer_drops) = lax.scan(
+            layer_fn, h, (layers, jnp.arange(Lp, dtype=jnp.int32))
+        )
+        return h, k_rows, v_rows, jnp.sum(layer_drops)
     h, (k_rows, v_rows) = lax.scan(
         layer_fn, h, (layers, jnp.arange(Lp, dtype=jnp.int32))
     )
@@ -872,8 +1005,10 @@ def decode(
     positions: jax.Array,  # [B] position of each token (its write slot)
     block_tables: jax.Array,  # [B, max_blocks]
     active: jax.Array,  # [B] bool — padded batch slots are False
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decode step for a batch. Returns (logits [B, V], k_cache, v_cache)."""
+    moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+) -> Tuple[jax.Array, ...]:
+    """One decode step for a batch. Returns (logits [B, V], k_cache, v_cache)
+    (+ capacity-MoE drop aux with ``moe_stats``)."""
     c = config
     bs = c.block_size
 
@@ -881,32 +1016,30 @@ def decode(
 
     tgt_blocks, tgt_offs, mask = decode_targets(positions, block_tables, active, bs)
 
-    # "auto" uses the XLA gather: measured on v5e (llama-3.2-1b, b8,
-    # ctx1024) it beats the Pallas kernel ~3× at equal effective context —
-    # XLA's fused gather+batched-matmul pipelines better than a per-sequence
-    # serial-grid kernel, and the scheduler's width bucketing keeps the
-    # gather close to the real context length. The kernel stays available
-    # (attention_impl="paged_kernel") for very long, fragmented contexts
-    # where table width far exceeds typical kv_len. Kernel needs Mosaic DMA
-    # alignment: lane dim KVH*HD % 128, sublane BS % 8.
-    aligned = (c.kv_size % 128 == 0) and (c.block_size % 8 == 0)
-    on_tpu = jax.default_backend() == "tpu"
-    use_kernel = c.attention_impl == "paged_kernel"
-    if c.attention_impl == "paged_kernel" and on_tpu and not aligned:
-        raise ValueError(
-            f"paged_kernel needs kv_heads*head_dim % 128 == 0 and block_size % 8 == 0 "
-            f"for Mosaic DMA alignment; got kv_size={c.kv_size}, block_size={c.block_size}"
+    # Decode attention is the width-bucketed XLA gather with a two-piece
+    # online-softmax merge (cached prefix + current token in-register). A
+    # Pallas paged-DMA kernel was measured 3-6x slower in every regime and
+    # deleted in r4 — see ModelConfig.attention_impl for the full record.
+    if moe_stats:
+        h, k_rows, v_rows, drops = decode_layer_scan(
+            params["layers"], c, k_cache, v_cache, h, positions,
+            block_tables, mask, active=active, moe_stats=True,
         )
-    # Cached tokens per row (current token folded in-register, not read back).
-    kv_lens = jnp.where(active, positions, 0)
-
-    h, k_rows, v_rows = decode_layer_scan(
-        params["layers"], c, k_cache, v_cache, h, positions,
-        block_tables, mask, kv_lens, use_kernel, active=active,
-    )
+    else:
+        h, k_rows, v_rows = decode_layer_scan(
+            params["layers"], c, k_cache, v_cache, h, positions,
+            block_tables, mask, active=active,
+        )
     k_new, v_new = scatter_kv_rows(k_cache, v_cache, k_rows, v_rows, tgt_blocks, tgt_offs)
 
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     logits = h @ (head if head is not None else params["embed"].T)
+    if moe_stats:
+        aux = {
+            "moe_dropped": drops,
+            "moe_assignments": jnp.sum(active).astype(jnp.int32)
+            * jnp.int32(max(c.num_experts_per_tok, 1) * c.num_layers),
+        }
+        return logits.astype(jnp.float32), k_new, v_new, aux
     return logits.astype(jnp.float32), k_new, v_new

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.engine.config import ModelConfig
-from dynamo_tpu.engine.models.llama import _mlp, apply_rope, rms_norm
+from dynamo_tpu.engine.models.llama import _gather_kv, _scatter_kv, _mlp, apply_rope, rms_norm
 
 Params = Dict[str, jax.Array]
 
@@ -166,7 +166,7 @@ def prefill(
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_new = _latent_kv(x, lp, c, positions)  # [T, R]
-        latent_ctx = k_flat[block_table + l * N].reshape(ctx, latent_width(c))
+        latent_ctx = _gather_kv(k_flat, block_table + l * N, h.dtype).reshape(ctx, latent_width(c))
         attn = _attend_latent(
             q_eff, q_rope, jnp.concatenate([latent_ctx, latent_new], axis=0), mask, lp, c
         )
@@ -180,7 +180,9 @@ def prefill(
     )
     L = c.num_layers
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, T))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
+    k_new = _scatter_kv(
+        k_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], latent_rows[:, :, None, :]
+    )
     last = jnp.maximum(valid_len - 1, 0)
     h_last = rms_norm(h[last], params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -224,7 +226,7 @@ def decode(
         # way it broadcasts per-token positions in prefill.
         q_eff, q_rope = _project_q(x, lp, c, positions)
         latent_row = _latent_kv(x, lp, c, positions)  # [B, R]
-        latent_ctx = k_flat[block_tables + l * N].reshape(B, ctx, R)
+        latent_ctx = _gather_kv(k_flat, block_tables + l * N, h.dtype).reshape(B, ctx, R)
         latent_full = jnp.concatenate([latent_ctx, latent_row[:, None]], axis=1)
         attn = jax.vmap(
             lambda qe, qr, lat, mb: _attend_latent(qe[None], qr[None], lat, mb[None], lp, c)[0]
@@ -239,7 +241,9 @@ def decode(
     )
     L = c.num_layers
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None, :], tgt_offs[None, :], 0].set(latent_rows)
+    k_new = _scatter_kv(
+        k_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], latent_rows[:, :, None, :]
+    )
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     logits = h @ (head if head is not None else params["embed"].T)
@@ -295,7 +299,7 @@ def decode_multi(
             x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
             q_eff, q_rope = _project_q(x, lp, c, poss)
             latent_row = _latent_kv(x, lp, c, poss)  # [B, R]
-            latent_ctx = k_flat[block_tables + l * N].reshape(B, ctx, R)
+            latent_ctx = _gather_kv(k_flat, block_tables + l * N, h.dtype).reshape(B, ctx, R)
             latent_full = jnp.concatenate(
                 [latent_ctx, jnp.swapaxes(lwl, 0, 1), latent_row[:, None]], axis=1
             )
@@ -319,7 +323,9 @@ def decode_multi(
         out = out.at[i].set(nxt)
         return (nxt, lat_win, out, key)
 
-    lat_win0 = jnp.zeros((L, num_steps, B, R), dtype=k_cache.dtype)
+    # Window rows are in-flight REAL values; int8 caches quantize only at
+    # the final fused scatter (k_cache.dtype would be int8 for QuantKv).
+    lat_win0 = jnp.zeros((L, num_steps, B, R), dtype=params["embed"].dtype)
     out0 = jnp.zeros((num_steps, B), dtype=jnp.int32)
     _, lat_win, out, _ = lax.fori_loop(0, num_steps, body, (tokens, lat_win0, out0, rng_key))
 
@@ -328,5 +334,7 @@ def decode_multi(
     tgt_blocks = jnp.where(active[None, :], block_tables[jnp.arange(B)[None, :], slots // bs], 0)
     tgt_offs = slots % bs
     layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, num_steps, B))
-    k_new = k_cache.at[layer_idx, tgt_blocks[None], tgt_offs[None], 0].set(lat_win)
+    k_new = _scatter_kv(
+        k_cache, layer_idx, tgt_blocks[None], tgt_offs[None], lat_win[:, :, :, None, :]
+    )
     return out, k_new, v_cache

@@ -1,0 +1,133 @@
+"""TPU-native ViT image encoder for the multimodal encode-worker role.
+
+Ref role: the encode worker that turns image inputs into embedding tensors
+handed to prefill (components/backends/trtllm/src/dynamo/trtllm/utils/
+encode_helper.py + the vllm/sglang image paths). The reference delegates
+the vision tower to its engines; here it is a native JAX module:
+
+- Patchify as ONE reshape+matmul (``[B, P, p*p*3] @ W``) — MXU-friendly,
+  no conv lowering needed.
+- Bidirectional transformer over stacked layers via ``lax.scan`` (one
+  compiled layer body), f32 norms / bf16 matmuls like the LM side.
+- Final projection to the language model's hidden size, so the output
+  rows drop directly into prefill's embedding stream
+  (llama.prefill ``mm_feats``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 12
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    lm_hidden_size: int = 2048  # projection target (the LM's hidden size)
+    layer_norm_eps: float = 1e-6
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+PRESETS = {
+    # Small tower for tests (CPU-friendly).
+    "tiny-vit": VisionConfig(
+        image_size=32, patch_size=8, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, lm_hidden_size=64,
+    ),
+    # CLIP-L/14-class tower projected to the 1B LM width.
+    "vit-l-14": VisionConfig(lm_hidden_size=2048),
+}
+
+
+def init_params(config: VisionConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    c = config
+    ks = jax.random.split(key, 10)
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    L, D, F = c.num_layers, c.hidden_size, c.intermediate_size
+    patch_dim = c.patch_size * c.patch_size * 3
+    return {
+        "patch_embed": dense(ks[0], (patch_dim, D), scale=0.02),
+        "pos_embed": dense(ks[1], (c.num_patches, D), scale=0.02),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype),
+            "ln1_b": jnp.zeros((L, D), dtype),
+            "ln2": jnp.ones((L, D), dtype),
+            "ln2_b": jnp.zeros((L, D), dtype),
+            "wq": dense(ks[2], (L, D, D)),
+            "wk": dense(ks[3], (L, D, D)),
+            "wv": dense(ks[4], (L, D, D)),
+            "wo": dense(ks[5], (L, D, D)),
+            "w_up": dense(ks[6], (L, D, F)),
+            "w_down": dense(ks[7], (L, F, D)),
+        },
+        "final_ln": jnp.ones((D,), dtype),
+        "final_ln_b": jnp.zeros((D,), dtype),
+        "proj": dense(ks[8], (D, c.lm_hidden_size)),
+    }
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps) * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] → [B, P, patch*patch*3] (row-major patch grid)."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def encode(params: Params, config: VisionConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] (f32 in [0, 1]) → features [B, P, lm_hidden] f32."""
+    c = config
+    x = patchify(images, c.patch_size).astype(params["patch_embed"].dtype)
+    h = x @ params["patch_embed"] + params["pos_embed"][None]  # [B, P, D]
+    B, P, D = h.shape
+    nh, hd = c.num_heads, c.head_dim
+    scale = hd**-0.5
+
+    def layer_fn(h, lp):
+        x = _layer_norm(h, lp["ln1"], lp["ln1_b"], c.layer_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, P, nh, hd)
+        k = (x @ lp["wk"]).reshape(B, P, nh, hd)
+        v = (x @ lp["wv"]).reshape(B, P, nh, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        p = jax.nn.softmax(s, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, P, D)
+        h = h + attn @ lp["wo"]
+        x = _layer_norm(h, lp["ln2"], lp["ln2_b"], c.layer_norm_eps)
+        h = h + jax.nn.gelu(x @ lp["w_up"]) @ lp["w_down"]
+        return h, None
+
+    h, _ = lax.scan(layer_fn, h, params["layers"])
+    h = _layer_norm(h, params["final_ln"], params["final_ln_b"], c.layer_norm_eps)
+    return (h @ params["proj"]).astype(jnp.float32)

@@ -64,11 +64,6 @@ def pipelined_decode(
     M = num_microbatches or pp
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible into {M} microbatches")
-    if c.attention_impl == "paged_kernel":
-        raise ValueError(
-            "attention_impl='paged_kernel' is not supported under pipeline "
-            "parallelism yet — the pipelined path uses the gather attention"
-        )
     if c.num_layers % pp != 0:
         raise ValueError(f"num_layers {c.num_layers} not divisible by pp {pp}")
     mb = B // M
@@ -111,8 +106,7 @@ def pipelined_decode(
             tgt_blocks, tgt_offs, mask = decode_targets(poss_i, tables_i, act_i, bs)
 
             h_out, k_rows, v_rows = decode_layer_scan(
-                layers, c, kc, vc, h_in, poss_i,
-                tables_i, mask, None, use_kernel=False, active=act_i,
+                layers, c, kc, vc, h_in, poss_i, tables_i, mask, active=act_i,
             )
             kc, vc = scatter_kv_rows(kc, vc, k_rows, v_rows, tgt_blocks, tgt_offs)
 

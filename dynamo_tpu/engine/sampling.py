@@ -25,6 +25,12 @@ class SamplingParams:
     # independent of batch composition (the key folds in the per-request
     # token position, not the global step counter).
     seed: Optional[int] = None
+    # OpenAI penalties over generated tokens (vLLM semantics: counts cover
+    # the OUTPUT so far, not the prompt). Applied to logits before
+    # temperature/top-k/top-p — ref: protocols/common SamplingOptions +
+    # protocols/openai/validate.rs.
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
     # Return the chosen token's log-probability with each step.
     logprobs: bool = False
     # Per-request processors (dynamo_tpu.logits_processing) — host path.
@@ -33,6 +39,10 @@ class SamplingParams:
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    @property
+    def has_penalties(self) -> bool:
+        return self.frequency_penalty != 0.0 or self.presence_penalty != 0.0
 
 
 # Top-k/top-p thresholds are resolved inside the best-SAMPLE_WINDOW logits
@@ -117,6 +127,32 @@ def sample_batch(
         return jnp.where(temperature > 0, sampled, greedy_tok)
 
     return jax.lax.cond(jnp.any(temperature > 0), sample_path, lambda _: greedy_tok, None)
+
+
+@jax.jit
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    hist: jax.Array,  # [B, H] i32 — generated-token history, padded
+    hist_len: jax.Array,  # [B] i32 — valid history per row
+    frequency_penalty: jax.Array,  # [B] f32
+    presence_penalty: jax.Array,  # [B] f32
+) -> jax.Array:
+    """Batched OpenAI frequency/presence penalties in ONE dispatch:
+    per-row output-token counts built by scatter-add from the padded
+    history, then ``logits - freq·count - pres·(count > 0)``. Host cost is
+    the [B, H] history upload (H = longest output, bucketed); the [B, V]
+    count tensor exists only on device. vLLM semantics: counts cover
+    generated tokens only, not the prompt."""
+    B, V = logits.shape
+    H = hist.shape[1]
+    valid = jnp.arange(H, dtype=jnp.int32)[None, :] < hist_len[:, None]
+    tok = jnp.where(valid, hist, 0)
+    counts = jnp.zeros((B, V), jnp.float32).at[
+        jnp.arange(B, dtype=jnp.int32)[:, None], tok
+    ].add(valid.astype(jnp.float32))  # padded rows add 0 to token 0
+    return logits - frequency_penalty[:, None] * counts - presence_penalty[:, None] * (
+        counts > 0
+    )
 
 
 @jax.jit

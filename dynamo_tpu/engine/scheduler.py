@@ -80,6 +80,10 @@ class StepOutput:
     finished: bool = False
     finish_reason: Optional[str] = None
     logprob: Optional[float] = None
+    # Set on the first token only: seconds the request waited between
+    # arrival and engine admission (the saturation signal the SLA planner
+    # inverts; ref: http_queue_guard, http/service/metrics.rs).
+    queue_s: Optional[float] = None
 
 
 @dataclass
@@ -98,6 +102,7 @@ class Sequence:
     num_cached_blocks: int = 0  # prefix blocks reused from cache
     out_queue: "asyncio.Queue[Optional[StepOutput]]" = field(default_factory=asyncio.Queue)
     arrival_ts: float = field(default_factory=time.monotonic)
+    admitted_ts: Optional[float] = None  # first engine work (queue-time end)
     first_token_ts: Optional[float] = None
     aborted: bool = False
     abort_reason: str = "cancelled"
@@ -106,6 +111,10 @@ class Sequence:
     keep_blocks_on_finish: bool = False
     # Decode-role sequences start from remotely prefilled KV.
     prefilled: Optional[dict] = None
+    # Multimodal: feature rows injected at positions [0, F) during prefill
+    # (the prompt's first F ids are placeholders). Disables prefix caching
+    # for the sequence (placeholder ids don't identify image content).
+    mm_features: Optional[np.ndarray] = None
     # Preemption resume: tokens whose KV must be recomputed (all generated
     # tokens fold in; the final token re-enters via decode, so no sampling
     # happens at the end of a resume prefill).
@@ -174,6 +183,10 @@ class ForwardPassMetrics:
     # Speculative decoding acceptance accounting (SpecDecodeStats.to_dict(),
     # None when no draft model is attached) — ref: _core.pyi:354-427.
     spec_decode: Optional[dict] = None
+    # Wide-EP capacity-dispatch pressure: (token, expert) assignments dropped
+    # by capacity limits / total routed assignments (capacity MoE only).
+    moe_dropped_total: int = 0
+    moe_assignments_total: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -251,16 +264,51 @@ class Scheduler:
         from dynamo_tpu.engine.models import get_module
 
         model = get_module(model_config)
-        self._prefill_jit = jax.jit(
-            lambda p, k, v, t, vl, cl, bt: model.prefill(p, self.mc, k, v, t, vl, cl, bt),
-            donate_argnums=(1, 2),
+        # Prefill impl: flash = Pallas kernel chunk attention (auto ⇒ TPU
+        # only; the interpreted kernel is far too slow for CPU serving).
+        self._use_flash_prefill = model_config.architecture == "llama" and (
+            model_config.prefill_impl == "flash"
+            or (model_config.prefill_impl == "auto" and jax.default_backend() == "tpu")
         )
+        # Capacity-dispatch MoE exports drop counters (wide-EP observability;
+        # ref: SURVEY.md §2e / trtllm_utils.py:37-39 wide-EP surface).
+        self._moe_stats = (
+            model_config.architecture == "llama"
+            and model_config.num_experts > 0
+            and model_config.moe_dispatch == "capacity"
+        )
+        self.moe_dropped_total = 0
+        self.moe_assignments_total = 0
+        # llama-only kwargs (MLA's forward has its own signature).
+        stats_kw = {"moe_stats": True} if self._moe_stats else {}
+        if self._use_flash_prefill:
+            self._prefill_jit = jax.jit(
+                lambda p, k, v, t, vl, cl, bt, hp: model.prefill(
+                    p, self.mc, k, v, t, vl, cl, bt, use_flash=True, has_prefix=hp,
+                    **stats_kw,
+                ),
+                donate_argnums=(1, 2),
+                static_argnums=(7,),
+            )
+        else:
+            # ``hp`` rides as a TRACED (unused) arg here: the XLA path's
+            # masks cover prefix and fresh prefills alike, and a static arg
+            # would compile two byte-identical executables per bucket.
+            self._prefill_jit = jax.jit(
+                lambda p, k, v, t, vl, cl, bt, hp: model.prefill(
+                    p, self.mc, k, v, t, vl, cl, bt, **stats_kw
+                ),
+                donate_argnums=(1, 2),
+            )
         self._decode_jit = jax.jit(
-            lambda p, k, v, t, pos, bt, act: model.decode(p, self.mc, k, v, t, pos, bt, act),
+            lambda p, k, v, t, pos, bt, act: model.decode(
+                p, self.mc, k, v, t, pos, bt, act, **stats_kw
+            ),
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)
         self.dtype = dtype
+        self._mm_jit = None  # lazy: multimodal prefill variant
         # Speculative decoding (attach_draft): draft model + stats.
         self.draft_params = None
         self.draft_cfg = None
@@ -272,7 +320,7 @@ class Scheduler:
             self._decode_multi_jit = jax.jit(
                 lambda p, k, v, t, pos, bt, act, te, tk, tp, key: model.decode_multi(
                     p, self.mc, k, v, t, pos, bt, act, te, tk, tp, key,
-                    self.sc.num_scheduler_steps,
+                    self.sc.num_scheduler_steps, **stats_kw,
                 ),
                 donate_argnums=(1, 2),
             )
@@ -293,35 +341,67 @@ class Scheduler:
         if draft_config.architecture != "llama" or self.mc.architecture != "llama":
             raise ValueError("spec decode needs llama-family draft AND target for now")
         if self.mesh is not None:
-            raise ValueError(
-                "spec decode with sharded serving is not supported yet: draft "
-                "params/cache would need the mesh shardings the target uses"
+            # Sharded serving: the draft rides the target's mesh — same
+            # partition specs, so GSPMD propagates the tp all-reduces / dp
+            # splits through the draft's jitted steps too.
+            from jax.sharding import NamedSharding
+
+            from dynamo_tpu.engine.sharding import kv_cache_spec, shard_params
+
+            tp = self.parallel.tp if self.parallel is not None else self.mesh.shape.get("tp", 1)
+            if tp > 1 and draft_config.num_kv_heads % tp:
+                raise ValueError(
+                    f"draft kv_heads {draft_config.num_kv_heads} not divisible by tp={tp}"
+                )
+            draft_params = shard_params(
+                draft_params, self.mesh, draft_config.tie_word_embeddings, draft_config.num_experts
             )
+            d_sharding = NamedSharding(self.mesh, kv_cache_spec(draft_config.num_kv_heads, tp))
+            self.draft_cache = KvCacheArrays.create(
+                draft_config, self.sc.num_blocks, dtype=self.dtype, sharding=d_sharding
+            )
+        else:
+            self.draft_cache = KvCacheArrays.create(draft_config, self.sc.num_blocks, dtype=self.dtype)
         self.draft_cfg = draft_config
         self.draft_params = draft_params
         self.spec_gamma = gamma
         self.spec_stats = SpecDecodeStats()
-        self.draft_cache = KvCacheArrays.create(draft_config, self.sc.num_blocks, dtype=self.dtype)
         dc = draft_config
         self._d_prefill_jit = jax.jit(
             lambda p, k, v, t, vl, cl, bt: llama.prefill(p, dc, k, v, t, vl, cl, bt),
             donate_argnums=(1, 2),
         )
-        self._d_chunk_jit = jax.jit(
-            lambda p, k, v, t, pos, val, bt: llama.chunk_decode(p, dc, k, v, t, pos, val, bt),
-            donate_argnums=(1, 2),
-        )
+
+        def d_chunk_sample(p, k, v, t, pos, val, bt, te, tk, tp, key):
+            # Draft catch-up chunk + FIRST proposal sampled from the row's
+            # last valid position with its own sampling params (greedy rows
+            # reduce to argmax). Returns the dist too — spec_verify needs it.
+            lg, k, v = llama.chunk_decode(p, dc, k, v, t, pos, val, bt, all_logits=True)
+            last = jnp.take_along_axis(
+                lg, jnp.maximum(val - 1, 0)[:, None, None], axis=1
+            )[:, 0]  # [B, V]
+            tok = sample_batch(last, te, tk, tp, key)
+            return tok.astype(jnp.int32), last, k, v
+
+        self._d_chunk_sample_jit = jax.jit(d_chunk_sample, donate_argnums=(1, 2))
+        t_stats_kw = {"moe_stats": True} if self._moe_stats else {}
         self._t_chunk_jit = jax.jit(
-            lambda p, k, v, t, pos, val, bt: llama.chunk_decode(p, self.mc, k, v, t, pos, val, bt),
+            lambda p, k, v, t, pos, val, bt: llama.chunk_decode(
+                p, self.mc, k, v, t, pos, val, bt, all_logits=True, **t_stats_kw
+            ),
             donate_argnums=(1, 2),
         )
+        from dynamo_tpu.engine.spec_decode import spec_verify
+
+        self._spec_verify_jit = jax.jit(spec_verify)
         if gamma > 1:
-            # On-device greedy window for proposals 2..γ: one dispatch + one
-            # sync instead of γ-1 round-trips (the host-dispatch overhead
-            # speculation exists to amortize).
+            # On-device window for proposals 2..γ: one dispatch + one sync
+            # instead of γ-1 round-trips; samples with the rows' REAL
+            # params and returns per-step logits for rejection sampling.
             self._d_multi_jit = jax.jit(
                 lambda p, k, v, t, pos, bt, act, te, tk, tp, key: llama.decode_multi(
-                    p, dc, k, v, t, pos, bt, act, te, tk, tp, key, gamma - 1
+                    p, dc, k, v, t, pos, bt, act, te, tk, tp, key, gamma - 1,
+                    return_logits=True,
                 ),
                 donate_argnums=(1, 2),
             )
@@ -336,11 +416,17 @@ class Scheduler:
         *,
         keep_blocks_on_finish: bool = False,
         prefilled: Optional[dict] = None,
+        mm_features: Optional[np.ndarray] = None,
     ) -> Sequence:
         if not token_ids:
             raise ValueError("empty prompt")
         if len(token_ids) >= self.mc.max_seq_len:
             raise ValueError(f"prompt length {len(token_ids)} >= max_seq_len {self.mc.max_seq_len}")
+        if mm_features is not None:
+            if self.mc.architecture != "llama":
+                raise ValueError("multimodal features require the llama prefill path")
+            if mm_features.shape[0] > len(token_ids):
+                raise ValueError("more multimodal feature rows than prompt tokens")
         seq = Sequence(
             request_id=request_id,
             prompt=list(token_ids),
@@ -349,6 +435,7 @@ class Scheduler:
             eos_token_ids=self._eos,
             keep_blocks_on_finish=keep_blocks_on_finish,
             prefilled=prefilled,
+            mm_features=mm_features,
         )
         self.waiting.append(seq)
         self.by_id[request_id] = seq
@@ -374,6 +461,8 @@ class Scheduler:
             prefill_tokens_in_flight=sum(len(s.prompt) - s.num_computed for s in self.waiting),
             request_total=self.request_total,
             spec_decode=self.spec_stats.to_dict() if self.spec_stats else None,
+            moe_dropped_total=self.moe_dropped_total,
+            moe_assignments_total=self.moe_assignments_total,
         )
 
     # --- step loop core (runs in worker thread) -----------------------------
@@ -435,7 +524,7 @@ class Scheduler:
             # all-or-nothing: a partial failure here re-runs next step, so any
             # acquired refs/blocks must be returned before backing off.
             try:
-                if self.sc.enable_prefix_caching:
+                if self.sc.enable_prefix_caching and seq.mm_features is None:
                     seq.block_hashes = extend_block_hashes([], pf_tokens, bs)
                     matched = self._match_prefix_tiers(seq)
                     # Keep at least one token to prefill so we always produce logits.
@@ -456,6 +545,8 @@ class Scheduler:
                 seq.num_computed = 0
                 raise
             seq.state = SeqState.PREFILL
+            if seq.admitted_ts is None:
+                seq.admitted_ts = time.monotonic()
 
         remaining = len(pf_tokens) - seq.num_computed
         chunk = min(remaining, self._chunk_budget())
@@ -465,18 +556,34 @@ class Scheduler:
         tokens = pf_tokens[seq.num_computed : seq.num_computed + chunk]
         padded = np.zeros((bucket,), dtype=np.int32)
         padded[: len(tokens)] = tokens
-        table = self._block_table(seq)
+        table = self._prefill_table(seq)
 
         t0 = time.monotonic() if self.sc.itl_budget_ms else None
-        logits, self.cache.k, self.cache.v = self._prefill_jit(
-            self.params,
-            self.cache.k,
-            self.cache.v,
-            jnp.asarray(padded),
-            jnp.int32(len(tokens)),
-            jnp.int32(seq.num_computed),
-            table,
-        )
+        if seq.mm_features is not None:
+            feats = seq.mm_features
+            fb = 16
+            while fb < feats.shape[0]:
+                fb *= 2
+            padded_f = np.zeros((fb, feats.shape[1]), dtype=np.float32)
+            padded_f[: feats.shape[0]] = feats
+            res = self._prefill_mm_jit()(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(padded), jnp.int32(len(tokens)), jnp.int32(seq.num_computed),
+                table, seq.num_computed > 0,
+                jnp.asarray(padded_f), jnp.int32(feats.shape[0]),
+            )
+        else:
+            res = self._prefill_jit(
+                self.params,
+                self.cache.k,
+                self.cache.v,
+                jnp.asarray(padded),
+                jnp.int32(len(tokens)),
+                jnp.int32(seq.num_computed),
+                table,
+                seq.num_computed > 0,
+            )
+        logits, self.cache.k, self.cache.v = self._consume_aux(res)
         if t0 is not None:
             # Sync to learn the chunk rate (feeds _chunk_budget's EMA).
             logits.block_until_ready()
@@ -521,8 +628,89 @@ class Scheduler:
         return max(min(cap, budget_tokens), self.sc.prefill_buckets[0])
 
     def _width_bucket(self, max_used: int) -> int:
-        width = max(4, ((max_used + 15) // 16) * 16) if max_used > 4 else 4
-        return min(width, self.max_blocks_per_seq)
+        """Power-of-two block-table widths (was: multiples of 16, which both
+        rounded 5 blocks up to 16 — a 3× oversized gather for short contexts
+        — and produced max_seq/256 executable variants that compiled mid-
+        traffic; measured as the dominant serving-plane cost). Pow2 bounds
+        the variants at log2(max_blocks) so warmup() can precompile them."""
+        w = 4
+        while w < max_used:
+            w *= 2
+        return min(w, self.max_blocks_per_seq)
+
+    def warmup(self, ctx_tokens: int = 2048) -> int:
+        """Precompile the serving-hot executables so traffic never waits on
+        XLA (the reference's engines warm up at startup for the same reason;
+        vLLM role: --enforce-eager off + warmup passes). Covers: decode
+        (every batch bucket × table widths up to ``ctx_tokens``), the
+        multi-step window variant when enabled, fresh-prefill chunks per
+        bucket, and the sampler per bucket. Dispatches run with all rows
+        inactive, so writes land in the reserved scratch block 0 and cache
+        contents are untouched. Returns the number of executables warmed."""
+        bs = self.mc.block_size
+        max_w = self._width_bucket((ctx_tokens + bs - 1) // bs)
+        widths = [max_w]  # always include the top (possibly clamped) width
+        w = 4
+        while w < max_w:
+            widths.append(w)
+            w *= 2
+        widths = sorted(set(widths))
+        count = 0
+        key = jax.random.PRNGKey(0)
+        for bucket in self.sc.decode_buckets:
+            for width in widths:
+                toks = jnp.zeros((bucket,), jnp.int32)
+                pos = jnp.zeros((bucket,), jnp.int32)
+                tables = jnp.zeros((bucket, width), jnp.int32)
+                active = jnp.zeros((bucket,), bool)
+                temps = jnp.zeros((bucket,), jnp.float32)
+                tks = jnp.zeros((bucket,), jnp.int32)
+                tps = jnp.ones((bucket,), jnp.float32)
+                logits, self.cache.k, self.cache.v = self._consume_aux(
+                    self._decode_jit(
+                        self.params, self.cache.k, self.cache.v, toks, pos, tables, active
+                    )
+                )
+                count += 1
+                if self.sc.num_scheduler_steps > 1 and self._supports_multi_step:
+                    _, self.cache.k, self.cache.v = self._consume_aux(
+                        self._decode_multi_jit(
+                            self.params, self.cache.k, self.cache.v, toks, pos, tables,
+                            active, temps, tks, tps, key,
+                        )
+                    )
+                    count += 1
+            self._sample_jit(
+                jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
+                jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
+                jnp.ones((bucket,), jnp.float32), key, None,
+            )
+            count += 1
+        for bucket in self.sc.prefill_buckets:
+            if bucket > self.sc.max_prefill_chunk:
+                continue
+            width = 16
+            while width * bs < bucket + 1:
+                width *= 2
+            width = min(width, self.max_blocks_per_seq)
+            # Both has_prefix variants: fresh prefills AND chunked/prefix-hit
+            # continuations must not compile mid-traffic. (On the XLA path
+            # hp is a traced no-op arg, so the second call is a cache hit.)
+            for hp in (False, True):
+                _, self.cache.k, self.cache.v = self._consume_aux(
+                    self._prefill_jit(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.zeros((bucket,), jnp.int32), jnp.int32(1), jnp.int32(0),
+                        jnp.zeros((width,), jnp.int32), hp,
+                    )
+                )
+            self._sample_jit(
+                jnp.zeros((1, self.mc.vocab_size), jnp.float32),
+                jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+                jnp.ones((1,), jnp.float32), key, None,
+            )
+            count += 1
+        return count
 
     def _draft_catchup(self, seq: Sequence, tokens: List[int], upto: int) -> None:
         """Materialize draft KV for positions seq.d_n..upto-1 (prefill-style
@@ -530,8 +718,8 @@ class Scheduler:
         remotely-prefilled prompts, and to re-sync rows whose draft lag
         outgrew the spec chunk width (e.g. after stretches of non-spec
         decode in mixed batches)."""
-        if self.draft_params is None:
-            return
+        if self.draft_params is None or seq.mm_features is not None:
+            return  # no vision path in the draft — mm rows decode unspeculated
         while seq.d_n < upto:
             start = seq.d_n
             chunk = min(upto - start, self.sc.max_prefill_chunk)
@@ -543,7 +731,7 @@ class Scheduler:
             _, self.draft_cache.k, self.draft_cache.v = self._d_prefill_jit(
                 self.draft_params, self.draft_cache.k, self.draft_cache.v,
                 jnp.asarray(padded), jnp.int32(len(toks)), jnp.int32(start),
-                self._block_table(seq),
+                self._prefill_table(seq),
             )
             seq.d_n += len(toks)
 
@@ -563,9 +751,13 @@ class Scheduler:
         if (
             self.draft_params is not None
             and not any(
-                seq.sampling.temperature != 0.0
-                or seq.sampling.logits_processors
+                seq.sampling.logits_processors
                 or seq.sampling.logprobs
+                or seq.sampling.has_penalties
+                or seq.mm_features is not None
+                # Seeded sampling needs per-row keys the spec round doesn't
+                # thread; greedy seeded rows are fine (seed is a no-op).
+                or (seq.sampling.seed is not None and seq.sampling.temperature > 0)
                 for seq in batch
             )
             and self._decode_spec(batch, bucket, outputs)
@@ -579,6 +771,7 @@ class Scheduler:
             and not any(
                 seq.sampling.logits_processors
                 or seq.sampling.logprobs
+                or seq.sampling.has_penalties  # history changes within the window
                 or (seq.sampling.seed is not None and seq.sampling.temperature > 0)
                 for seq in batch
             )
@@ -588,9 +781,9 @@ class Scheduler:
 
         # Bucket the block-table width by the longest sequence in the batch:
         # the attention gather is O(table_width), so short contexts must not
-        # pay for max_seq_len. 16-block (256-token) granularity keeps the
-        # gather within ~25% of the true context while bounding the
-        # executable count at max_seq_len/256 variants.
+        # pay for max_seq_len. Power-of-two widths (see _width_bucket) bound
+        # the executable count at log2(max_blocks) so warmup() precompiles
+        # them all.
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
 
         tokens = np.zeros((bucket,), dtype=np.int32)
@@ -612,15 +805,22 @@ class Scheduler:
             top_ks[i] = seq.sampling.top_k
             top_ps[i] = seq.sampling.top_p
 
-        logits, self.cache.k, self.cache.v = self._decode_jit(
-            self.params,
-            self.cache.k,
-            self.cache.v,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(tables),
-            jnp.asarray(active),
+        logits, self.cache.k, self.cache.v = self._consume_aux(
+            self._decode_jit(
+                self.params,
+                self.cache.k,
+                self.cache.v,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(tables),
+                jnp.asarray(active),
+            )
         )
+        # Frequency/presence penalties: one batched device op for the whole
+        # step (per-row output-token counts via scatter-add — sampling.py).
+        # Penalty-free batches skip it entirely.
+        if any(seq.sampling.has_penalties for seq in batch):
+            logits = self._apply_penalties(batch, bucket, logits)
         # Per-request logits processors (dynamo_tpu.logits_processing): the
         # host path — one device→host sync for the rows that opted in, so
         # processor-free batches stay on the fast path.
@@ -714,12 +914,13 @@ class Scheduler:
 
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
-        toks_out, self.cache.k, self.cache.v = self._decode_multi_jit(
+        res = self._decode_multi_jit(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
             jnp.asarray(top_ps), key,
         )
+        toks_out, self.cache.k, self.cache.v = self._consume_aux(res)
         sampled = np.asarray(toks_out)  # [steps, bucket] — the one host sync
 
         for i, seq in enumerate(batch):
@@ -731,10 +932,12 @@ class Scheduler:
 
     def _decode_spec(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
         """One speculative round for the whole batch: the draft catches up on
-        any unconsumed confirmed tokens and proposes γ tokens (one chunk pass
-        + γ-1 single steps), the target verifies [last ; proposals] in ONE
-        chunk pass, and each row advances by accepted+1 tokens. Greedy rows
-        only (the caller checks). Returns False to fall back to normal
+        any unconsumed confirmed tokens and proposes γ SAMPLED tokens (one
+        chunk pass + a γ-1 window), the target scores [last ; proposals] in
+        ONE chunk pass, and rejection sampling (spec_decode.spec_verify)
+        accepts a prefix + a correction/bonus token per row — the output
+        distribution equals sampling the target directly; greedy rows reduce
+        to exact argmax agreement. Returns False to fall back to normal
         decode when blocks/limits don't allow a full window."""
         gamma = self.spec_gamma
         S = gamma + 1
@@ -761,44 +964,54 @@ class Scheduler:
         d_toks = np.zeros((B, S), dtype=np.int32)
         d_pos0 = np.zeros((B,), dtype=np.int32)
         d_valid = np.zeros((B,), dtype=np.int32)
+        temps = np.zeros((B,), dtype=np.float32)
+        top_ks = np.zeros((B,), dtype=np.int32)
+        top_ps = np.ones((B,), dtype=np.float32)
         for i, seq in enumerate(batch):
             lag = seq.total_len - seq.d_n  # ≥ 1: the last token is never materialized
             d_toks[i, :lag] = seq.all_ids[seq.d_n :]
             d_pos0[i] = seq.d_n
             d_valid[i] = lag
             tables[i, : len(seq.block_ids)] = seq.block_ids
+            temps[i] = seq.sampling.temperature
+            top_ks[i] = seq.sampling.top_k
+            top_ps[i] = seq.sampling.top_p
         tables_j = jnp.asarray(tables)
+        temps_j, tks_j, tps_j = jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps)
 
-        # Draft: catch-up chunk (first proposal from its last valid position),
-        # then γ-1 single steps.
-        d_preds, self.draft_cache.k, self.draft_cache.v = self._d_chunk_jit(
+        # Draft: catch-up chunk + SAMPLED first proposal (+ its dist), then
+        # γ-1 sampled window steps with per-step logits.
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng, self._step_counter)
+        tok1, lg1, self.draft_cache.k, self.draft_cache.v = self._d_chunk_sample_jit(
             self.draft_params, self.draft_cache.k, self.draft_cache.v,
             jnp.asarray(d_toks), jnp.asarray(d_pos0), jnp.asarray(d_valid), tables_j,
+            temps_j, tks_j, tps_j, key,
         )
-        d_preds_h = np.asarray(d_preds)
+        tok1_h = np.asarray(tok1)
         proposals = np.zeros((B, gamma), dtype=np.int32)
-        cur = np.zeros((B,), dtype=np.int32)
         poss = np.zeros((B,), dtype=np.int32)
         act = np.zeros((B,), dtype=bool)
         for i, seq in enumerate(batch):
-            proposals[i, 0] = d_preds_h[i, d_valid[i] - 1]
-            cur[i] = proposals[i, 0]
+            proposals[i, 0] = tok1_h[i]
             poss[i] = seq.total_len
             act[i] = True
         if gamma > 1:
-            # Proposals 2..γ in ONE on-device greedy window (decode_multi):
-            # one dispatch + one sync instead of γ-1 host round-trips.
             self._step_counter += 1
-            key = jax.random.fold_in(self._rng, self._step_counter)
-            zeros_f = jnp.zeros((B,), jnp.float32)
-            toks_out, self.draft_cache.k, self.draft_cache.v = self._d_multi_jit(
+            key2 = jax.random.fold_in(self._rng, self._step_counter)
+            toks_out, lg_steps, self.draft_cache.k, self.draft_cache.v = self._d_multi_jit(
                 self.draft_params, self.draft_cache.k, self.draft_cache.v,
-                jnp.asarray(cur), jnp.asarray(poss), tables_j, jnp.asarray(act),
-                zeros_f, jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32), key,
+                tok1, jnp.asarray(poss), tables_j, jnp.asarray(act),
+                temps_j, tks_j, tps_j, key2,
             )
             proposals[:, 1:] = np.asarray(toks_out).T
+            draft_logits = jnp.concatenate(
+                [lg1[:, None], jnp.transpose(lg_steps, (1, 0, 2))], axis=1
+            )  # [B, γ, V]
+        else:
+            draft_logits = lg1[:, None]
 
-        # Target: verify [last_confirmed ; proposals] in one chunk pass.
+        # Target: score [last_confirmed ; proposals] in one chunk pass.
         t_toks = np.zeros((B, S), dtype=np.int32)
         t_pos0 = np.zeros((B,), dtype=np.int32)
         t_valid = np.zeros((B,), dtype=np.int32)
@@ -807,23 +1020,31 @@ class Scheduler:
             t_toks[i, 1:] = proposals[i]
             t_pos0[i] = seq.total_len - 1
             t_valid[i] = S
-        t_preds, self.cache.k, self.cache.v = self._t_chunk_jit(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(t_toks), jnp.asarray(t_pos0), jnp.asarray(t_valid), tables_j,
+        t_logits, self.cache.k, self.cache.v = self._consume_aux(
+            self._t_chunk_jit(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(t_toks), jnp.asarray(t_pos0), jnp.asarray(t_valid), tables_j,
+            )
         )
-        t_preds_h = np.asarray(t_preds)
+
+        # Rejection-sampling verification (greedy rows: exact argmax check).
+        self._step_counter += 1
+        vkey = jax.random.fold_in(self._rng, self._step_counter)
+        accepted, next_tok = self._spec_verify_jit(
+            draft_logits, t_logits, jnp.asarray(proposals), temps_j, tks_j, tps_j, vkey
+        )
+        accepted_h = np.asarray(accepted)
+        next_h = np.asarray(next_tok)
 
         st = self.spec_stats
         st.num_rounds += 1
         for i, seq in enumerate(batch):
             if seq.state != SeqState.RUNNING:
                 continue
-            k = 0
-            while k < gamma and proposals[i, k] == t_preds_h[i, k]:
-                k += 1
+            k = int(accepted_h[i])
             st.record_round(k, gamma)
             old_total = seq.total_len
-            for t in list(proposals[i, :k]) + [int(t_preds_h[i, k])]:
+            for t in list(proposals[i, :k]) + [int(next_h[i])]:
                 if seq.state != SeqState.RUNNING:
                     break  # stop hit mid-chunk; stale KV rows are position-masked
                 self._append_token(seq, int(t), outputs)
@@ -853,6 +1074,8 @@ class Scheduler:
             for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
                 scatter_blocks(self.cache, bid, k_np, v_np)
         seq.num_computed = len(seq.prompt)
+        if seq.admitted_ts is None:
+            seq.admitted_ts = time.monotonic()
         # Spec decode: the draft cache has nothing for remotely-prefilled KV —
         # compute the draft's own prompt KV before the row joins spec rounds.
         self._draft_catchup_prefill(seq, seq.prompt)
@@ -927,6 +1150,50 @@ class Scheduler:
         table[: len(seq.block_ids)] = seq.block_ids
         return jnp.asarray(table)
 
+    def _consume_aux(self, res):
+        """Strip + accumulate the moe-stats aux (when enabled) from a jitted
+        step's result tuple."""
+        if not self._moe_stats:
+            return res
+        *main, aux = res
+        self.moe_dropped_total += int(np.asarray(aux["moe_dropped"]))
+        self.moe_assignments_total += int(np.asarray(aux["moe_assignments"]))
+        return tuple(main)
+
+    def _prefill_mm_jit(self):
+        """Lazy jit of the multimodal prefill variant (feature injection)."""
+        if self._mm_jit is None:
+            from dynamo_tpu.engine.models import get_module
+
+            model = get_module(self.mc)
+            uf = self._use_flash_prefill
+
+            self._mm_jit = jax.jit(
+                lambda p, k, v, t, vl, cl, bt, hp, mf, ml: model.prefill(
+                    p, self.mc, k, v, t, vl, cl, bt,
+                    use_flash=uf, has_prefix=hp, mm_feats=mf, mm_len=ml,
+                    moe_stats=self._moe_stats,
+                ),
+                donate_argnums=(1, 2),
+                static_argnums=(7,),
+            )
+        return self._mm_jit
+
+    def _prefill_table(self, seq: Sequence) -> jnp.ndarray:
+        """Prefill block table bucketed to a power-of-two width covering the
+        sequence's blocks — NOT padded to max_blocks_per_seq. The prefill
+        prefix gather/mask is O(width·block_size), so a 2K prompt must not
+        pay for a 128K max_seq_len (measured: the dominant prefill cost at
+        1B on v5e before this). Power-of-two widths bound the executable
+        count at log2(max_blocks) variants per prefill bucket."""
+        w = 16
+        while w < len(seq.block_ids):
+            w *= 2
+        w = min(w, self.max_blocks_per_seq)
+        table = np.zeros((w,), dtype=np.int32)
+        table[: len(seq.block_ids)] = seq.block_ids
+        return jnp.asarray(table)
+
     def _ensure_block_capacity(self, seq: Sequence) -> None:
         """Grow the block table if the *next* token would overflow it.
         On OutOfBlocks, preempt the newest other running sequence (recompute
@@ -971,6 +1238,34 @@ class Scheduler:
         logger.info("preempted %s (len %d) to free blocks", victim.request_id, victim.total_len)
         return True
 
+    def _apply_penalties(self, batch: List[Sequence], bucket: int, logits: jax.Array) -> jax.Array:
+        """Apply frequency/presence penalties for the rows that request them
+        (sampling.apply_penalties). History width buckets to powers of two so
+        the executable count stays bounded as outputs grow."""
+        from dynamo_tpu.engine.sampling import apply_penalties
+
+        H = 16
+        longest = max(
+            (len(s.output_ids) for s in batch if s.sampling.has_penalties), default=0
+        )
+        while H < longest:
+            H *= 2
+        hist = np.zeros((bucket, H), dtype=np.int32)
+        hist_len = np.zeros((bucket,), dtype=np.int32)
+        freq = np.zeros((bucket,), dtype=np.float32)
+        pres = np.zeros((bucket,), dtype=np.float32)
+        for i, seq in enumerate(batch):
+            if not seq.sampling.has_penalties or not seq.output_ids:
+                continue
+            n = len(seq.output_ids)
+            hist[i, :n] = seq.output_ids
+            hist_len[i] = n
+            freq[i] = seq.sampling.frequency_penalty
+            pres[i] = seq.sampling.presence_penalty
+        return apply_penalties(
+            logits, jnp.asarray(hist), jnp.asarray(hist_len), jnp.asarray(freq), jnp.asarray(pres)
+        )
+
     def _row_key(self, seq: Sequence) -> jax.Array:
         """Per-row PRNG key. Seeded requests fold the per-request position
         (same seed + prompt ⇒ same samples, whatever the batch around them);
@@ -1009,15 +1304,20 @@ class Scheduler:
             logprob = getattr(seq, "_pending_logprob", None)
             seq._pending_logprob = None
         seq.output_ids.append(token)
+        # First token carries the request's queue time (arrival → admission).
+        queue_s = None
+        if len(seq.output_ids) == 1 and seq.admitted_ts is not None:
+            queue_s = max(0.0, seq.admitted_ts - seq.arrival_ts)
         reason = self._check_stop(seq, token)
         if reason is not None:
             # Token that triggered 'stop' is still emitted (backend strips).
             outputs.append(
-                (seq, StepOutput(token_id=token, finished=True, finish_reason=reason, logprob=logprob))
+                (seq, StepOutput(token_id=token, finished=True, finish_reason=reason,
+                                 logprob=logprob, queue_s=queue_s))
             )
             self._finish(seq, reason, outputs, emit=False)
         else:
-            outputs.append((seq, StepOutput(token_id=token, logprob=logprob)))
+            outputs.append((seq, StepOutput(token_id=token, logprob=logprob, queue_s=queue_s)))
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:
         n_out = len(seq.output_ids)
@@ -1047,7 +1347,8 @@ class Scheduler:
         seq.state = SeqState.FINISHED
         # Extend hashes over generated tokens so completed output blocks are
         # reusable too (multi-turn: next request's prompt includes them).
-        if self.sc.enable_prefix_caching and reason != "cancelled":
+        # mm sequences never register: placeholder ids don't hash the image.
+        if self.sc.enable_prefix_caching and reason != "cancelled" and seq.mm_features is None:
             bs = self.mc.block_size
             seq.block_hashes = extend_block_hashes(seq.block_hashes, seq.all_ids, bs)
             n_full = len(seq.all_ids) // bs

@@ -213,3 +213,88 @@ class SpecDecoder:
             # catch-up loop re-materialized everything through old_n.
             d_n = old_n + 1 + min(k, self.gamma - 1)
         return out[:max_tokens]
+
+
+# ---------------------------------------------------------------------------
+# Sampled (rejection-sampling) verification — Leviathan et al. speculative
+# sampling, generalized to mixed greedy/sampled batches. The output
+# distribution provably equals sampling from the target alone.
+# ---------------------------------------------------------------------------
+
+
+def _filtered_probs(logits, temps, top_ks, top_ps):
+    """Row-wise sampling distribution: temperature scale + top-k/top-p
+    truncation + softmax. logits [B, S, V]; params [B] → probs [B, S, V].
+    Greedy rows (temp 0) return a one-hot argmax distribution.
+
+    Thresholds come from sampling._exact_thresholds — the SAME math
+    sample_batch's exact path uses, so the draft's proposal distribution
+    and this verifier's p_d agree exactly (a divergence would bias the
+    rejection-sampled output distribution). Cost note: this is the
+    full-vocab-sort path (~ms at 128k vocab); a windowed variant like
+    sample_batch's SAMPLE_WINDOW fast path is a known optimization once
+    spec rounds show up in serving profiles."""
+    from dynamo_tpu.engine.sampling import _exact_thresholds
+
+    B, S, V = logits.shape
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None, None]
+    scaled = (logits / safe_t).reshape(B * S, V)
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    tk = jnp.repeat(top_ks, S)
+    tp = jnp.repeat(top_ps, S)
+    thresh = _exact_thresholds(scaled, lse, tk, tp)  # [B*S]
+    masked = jnp.where(scaled >= thresh[:, None], scaled, -jnp.inf)
+    probs = jax.nn.softmax(masked, axis=-1).reshape(B, S, V)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), V, dtype=probs.dtype)
+    return jnp.where((temps > 0)[:, None, None], probs, greedy)
+
+
+def spec_verify(
+    draft_logits: jax.Array,  # [B, G, V] — draft dist at each proposal position
+    target_logits: jax.Array,  # [B, G+1, V] — target dist at those + bonus position
+    proposals: jax.Array,  # [B, G] i32
+    temps: jax.Array,  # [B] f32 (0 = greedy row)
+    top_ks: jax.Array,  # [B] i32
+    top_ps: jax.Array,  # [B] f32
+    key: jax.Array,
+):
+    """Batched speculative verification → (accepted [B] i32, next_token [B]).
+
+    Sampled rows: accept proposal i with prob min(1, p_t(x_i)/p_d(x_i));
+    on first rejection sample the correction from norm(max(p_t − p_d, 0));
+    if all γ accepted, sample the bonus from the target's γ+1-th dist.
+    Greedy rows reduce to argmax agreement + argmax bonus (the one-hot
+    distributions make the same formulas exact). Ref surface:
+    SpecDecodeStats (_core.pyi:354-427); algorithm: speculative sampling.
+    """
+    B, G, V = draft_logits.shape
+    pd = _filtered_probs(draft_logits, temps, top_ks, top_ps)  # [B, G, V]
+    pt = _filtered_probs(target_logits[:, :G], temps, top_ks, top_ps)  # [B, G, V]
+    pt_x = jnp.take_along_axis(pt, proposals[..., None], axis=-1)[..., 0]  # [B, G]
+    pd_x = jnp.take_along_axis(pd, proposals[..., None], axis=-1)[..., 0]
+    key_u, key_resid, key_bonus = jax.random.split(key, 3)
+    u = jax.random.uniform(key_u, (B, G))
+    ratio = pt_x / jnp.maximum(pd_x, 1e-20)
+    accept = u < jnp.minimum(ratio, 1.0)  # [B, G]
+    # First rejection index; G if none.
+    rejected = ~accept
+    first_rej = jnp.where(
+        jnp.any(rejected, axis=1), jnp.argmax(rejected, axis=1), G
+    ).astype(jnp.int32)  # [B]
+
+    # Correction token at the first rejected position: norm(max(pt-pd, 0)).
+    idx = jnp.clip(first_rej, 0, G - 1)
+    pt_k = jnp.take_along_axis(pt, idx[:, None, None], axis=1)[:, 0]  # [B, V]
+    pd_k = jnp.take_along_axis(pd, idx[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(pt_k - pd_k, 0.0)
+    resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+    # Degenerate residual (identical dists): fall back to pt_k.
+    resid = jnp.where(resid_sum > 1e-20, resid / jnp.maximum(resid_sum, 1e-20), pt_k)
+    corr = jax.random.categorical(key_resid, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1)
+
+    # Bonus token when everything accepted: target's G+1-th distribution.
+    pt_bonus = _filtered_probs(target_logits[:, G:], temps, top_ks, top_ps)[:, 0]  # [B, V]
+    bonus = jax.random.categorical(key_bonus, jnp.log(jnp.maximum(pt_bonus, 1e-30)), axis=-1)
+
+    next_token = jnp.where(first_rej == G, bonus, corr).astype(jnp.int32)
+    return first_rej, next_token

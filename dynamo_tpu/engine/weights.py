@@ -39,12 +39,18 @@ def config_from_hf(path: str) -> ModelConfig:
 
 
 def load_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
-    """Load HF Llama safetensors from a local directory into stacked params."""
+    """Load a llama-family checkpoint into stacked params: HF safetensors
+    directory, a .gguf file, or a directory holding one."""
+    if os.path.isfile(path) and path.endswith(".gguf"):
+        return load_gguf_checkpoint(path, config, dtype=dtype)
     from safetensors import safe_open
 
     files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
     if not files:
-        raise FileNotFoundError(f"no .safetensors files in {path}")
+        ggufs = sorted(f for f in os.listdir(path) if f.endswith(".gguf"))
+        if ggufs:
+            return load_gguf_checkpoint(os.path.join(path, ggufs[0]), config, dtype=dtype)
+        raise FileNotFoundError(f"no .safetensors or .gguf files in {path}")
 
     raw: Dict[str, np.ndarray] = {}
     for fname in files:
@@ -87,13 +93,110 @@ def load_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
     return params
 
 
+def config_from_gguf(path: str) -> ModelConfig:
+    """Architecture record from GGUF metadata (ref: local_model.rs GGUF
+    resolution + gguf/ parsing)."""
+    from dynamo_tpu.llm.gguf import parse_gguf
+
+    meta = parse_gguf(path)
+    hidden = int(meta.arch_field("embedding_length") or 0)
+    heads = int(meta.arch_field("attention.head_count") or 0)
+    vocab = None
+    for t in meta.tensors:
+        if t.name == "token_embd.weight":
+            vocab = int(t.shape[-1])  # ne = [hidden, vocab]
+    if vocab is None:
+        toks = meta.tokens
+        vocab = len(toks) if toks else 0
+    has_head = any(t.name == "output.weight" for t in meta.tensors)
+    return ModelConfig(
+        name=meta.model_name or os.path.basename(path),
+        vocab_size=vocab,
+        hidden_size=hidden,
+        num_layers=int(meta.num_layers or 0),
+        num_heads=heads,
+        num_kv_heads=int(meta.arch_field("attention.head_count_kv") or heads),
+        head_dim=hidden // max(heads, 1),
+        intermediate_size=int(meta.arch_field("feed_forward_length") or 0),
+        rope_theta=float(meta.arch_field("rope.freq_base") or 500000.0),
+        rms_norm_eps=float(meta.arch_field("attention.layer_norm_rms_epsilon") or 1e-5),
+        max_seq_len=int(meta.context_length or 8192),
+        tie_word_embeddings=not has_head,
+    )
+
+
+def load_gguf_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
+    """Load a GGUF llama-family checkpoint (f32/f16/bf16/q8_0 tensors) into
+    stacked params. GGUF matrices read back HF-style [out, in] (gguf.py
+    read_tensor), so the same transpose applies as for safetensors."""
+    from dynamo_tpu.llm.gguf import load_tensors
+
+    raw = load_tensors(path)
+    c = config
+    L = c.num_layers
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        layers = [raw[fmt.format(l)] for l in range(L)]
+        arr = np.stack(layers)
+        if transpose:
+            arr = arr.transpose(0, 2, 1)
+        return jnp.asarray(arr, dtype=dtype)
+
+    params = {
+        "embed": jnp.asarray(raw["token_embd.weight"], dtype=dtype),
+        "final_norm": jnp.asarray(raw["output_norm.weight"], dtype=dtype),
+        "layers": {
+            "attn_norm": jnp.asarray(
+                np.stack([raw[f"blk.{l}.attn_norm.weight"] for l in range(L)]), dtype=dtype
+            ),
+            "mlp_norm": jnp.asarray(
+                np.stack([raw[f"blk.{l}.ffn_norm.weight"] for l in range(L)]), dtype=dtype
+            ),
+            "wq": stack("blk.{}.attn_q.weight"),
+            "wk": stack("blk.{}.attn_k.weight"),
+            "wv": stack("blk.{}.attn_v.weight"),
+            "wo": stack("blk.{}.attn_output.weight"),
+            "w_gate": stack("blk.{}.ffn_gate.weight"),
+            "w_up": stack("blk.{}.ffn_up.weight"),
+            "w_down": stack("blk.{}.ffn_down.weight"),
+        },
+    }
+    if not c.tie_word_embeddings and "output.weight" in raw:
+        params["lm_head"] = jnp.asarray(raw["output.weight"].T, dtype=dtype)
+    return params
+
+
+def _has_weights(d: str) -> bool:
+    try:
+        return any(f.endswith((".safetensors", ".gguf")) for f in os.listdir(d))
+    except OSError:
+        return False
+
+
 def resolve_model(name_or_path: str) -> Optional[str]:
-    """Return a local checkpoint dir if one exists (no network egress)."""
-    candidates = [
-        name_or_path,
-        os.path.expanduser(f"~/.cache/huggingface/hub/models--{name_or_path.replace('/', '--')}"),
-    ]
-    for c in candidates:
-        if os.path.isdir(c) and any(f.endswith(".safetensors") for f in os.listdir(c)):
-            return c
+    """Resolve a name/path to a local checkpoint (no network egress):
+
+    1. A directory with safetensors/GGUF files, or a GGUF file path.
+    2. The HF cache layout (hub.rs:299 role):
+       ``~/.cache/huggingface/hub/models--ORG--NAME/snapshots/<rev>/`` with
+       the revision taken from ``refs/main`` when present.
+    """
+    if os.path.isfile(name_or_path) and name_or_path.endswith(".gguf"):
+        return name_or_path
+    if os.path.isdir(name_or_path) and _has_weights(name_or_path):
+        return name_or_path
+    root = os.environ.get("HF_HOME") or os.path.expanduser("~/.cache/huggingface")
+    repo = os.path.join(root, "hub", f"models--{name_or_path.replace('/', '--')}")
+    snaps = os.path.join(repo, "snapshots")
+    if os.path.isdir(snaps):
+        rev = None
+        ref_main = os.path.join(repo, "refs", "main")
+        if os.path.isfile(ref_main):
+            with open(ref_main) as f:
+                rev = f.read().strip()
+        candidates = [rev] if rev else sorted(os.listdir(snaps))
+        for r in candidates:
+            d = os.path.join(snaps, r) if r else None
+            if d and os.path.isdir(d) and _has_weights(d):
+                return d
     return None

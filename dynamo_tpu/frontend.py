@@ -31,6 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-cache-block-size", type=int, default=16)
     p.add_argument("--tls-cert-path", default=None, help="PEM cert: serve HTTPS")
     p.add_argument("--tls-key-path", default=None, help="PEM private key")
+    p.add_argument("--encode-component", default=None,
+                   help="route image content parts to this encode-worker component (multimodal)")
     return p
 
 
@@ -49,6 +51,7 @@ async def amain(args) -> None:
         namespace=args.namespace,
         tls_cert=args.tls_cert_path,
         tls_key=args.tls_key_path,
+        encode_component=args.encode_component,
     )
     service = await start_frontend(drt, config)
     logger.info("frontend ready on %s:%d (router=%s)", args.http_host, service.port, args.router_mode)

@@ -62,6 +62,19 @@ class Backend(Operator):
     def __init__(self, tokenizer: Tokenizer):
         self.tokenizer = tokenizer
 
+    async def transform_request(self, request, context: Context):
+        # Images must have been consumed by an EncodeOperator upstream; a
+        # pipeline without one must REJECT image requests, not silently
+        # answer from the text alone (multimodal.py topology).
+        if isinstance(request, dict) and request.get("_mm_image_urls"):
+            from dynamo_tpu.llm.protocols.openai import RequestError
+
+            raise RequestError(
+                "request carries image content but no encode path is "
+                "configured (frontend --encode-component / pipeline encoder)"
+            )
+        return request
+
     def transform_response(self, stream: AsyncIterator, request: dict, context: Context) -> AsyncIterator:
         stop_strings: List[str] = list((request.get("stop_conditions") or {}).get("stop") or [])
         # EOS/stop tokens are stripped from text output.
@@ -110,6 +123,12 @@ class Backend(Operator):
                     continue
                 wire = item.data if isinstance(item, Annotated) else item
                 out = LLMEngineOutput.from_wire(wire)
+                if isinstance(wire, dict) and wire.get("queue_s") is not None:
+                    # Engine admission queue time (first frame): surfaced as
+                    # an annotation so the frontend can histogram it — the
+                    # saturation signal the SLA planner needs (ref:
+                    # http_queue_guard, http/service/metrics.rs).
+                    yield Annotated(event="_queue", comment=str(wire["queue_s"]))
                 if stopped:
                     # Upstream kept generating past a stop hit (shouldn't with
                     # prompt engines, possible with remote) — swallow.

@@ -39,9 +39,25 @@ class RouterEngine:
         return self.router.generate(request, context)
 
 
-def build_local_pipeline(tokenizer: Tokenizer, engine: AsyncEngine, card: Optional[ModelDeploymentCard] = None) -> AsyncEngine:
-    """Aggregated in-process pipeline: preprocessor → backend → engine
-    (ref: EngineConfig::StaticFull)."""
+def _encode_op(encoder, encode_client):
+    if encoder is None and encode_client is None:
+        return None
+    from dynamo_tpu.llm.multimodal import EncodeOperator
+
+    return EncodeOperator(encoder=encoder, client=encode_client)
+
+
+def build_local_pipeline(
+    tokenizer: Tokenizer,
+    engine: AsyncEngine,
+    card: Optional[ModelDeploymentCard] = None,
+    *,
+    encoder=None,
+    encode_client=None,
+) -> AsyncEngine:
+    """Aggregated in-process pipeline: preprocessor → [encode] → backend →
+    engine (ref: EngineConfig::StaticFull). ``encoder``/``encode_client``
+    enable the multimodal image path (multimodal.py)."""
     formatter = PromptFormatter(card.chat_template if card else None)
     pre = OpenAIPreprocessor(
         tokenizer,
@@ -49,7 +65,12 @@ def build_local_pipeline(tokenizer: Tokenizer, engine: AsyncEngine, card: Option
         tool_call_parser=card.tool_call_parser if card else None,
         reasoning_parser=card.reasoning_parser if card else None,
     )
-    return link([pre, Backend(tokenizer)], engine)
+    ops = [pre]
+    enc = _encode_op(encoder, encode_client)
+    if enc is not None:
+        ops.append(enc)
+    ops.append(Backend(tokenizer))
+    return link(ops, engine)
 
 
 def build_routed_pipeline(
@@ -58,9 +79,11 @@ def build_routed_pipeline(
     card: Optional[ModelDeploymentCard] = None,
     *,
     migration_limit: int = 0,
+    encoder=None,
+    encode_client=None,
 ) -> AsyncEngine:
-    """Frontend-side routed pipeline: preprocessor → backend → migration →
-    router (ref: input/common.rs:226)."""
+    """Frontend-side routed pipeline: preprocessor → [encode] → backend →
+    migration → router (ref: input/common.rs:226)."""
     formatter = PromptFormatter(card.chat_template if card else None)
     pre = OpenAIPreprocessor(
         tokenizer,
@@ -68,7 +91,11 @@ def build_routed_pipeline(
         tool_call_parser=card.tool_call_parser if card else None,
         reasoning_parser=card.reasoning_parser if card else None,
     )
-    ops = [pre, Backend(tokenizer)]
+    ops = [pre]
+    enc = _encode_op(encoder, encode_client)
+    if enc is not None:
+        ops.append(enc)
+    ops.append(Backend(tokenizer))
     limit = migration_limit if migration_limit else (card.migration_limit if card else 0)
     if limit > 0:
         ops.append(Migration(limit))
@@ -118,6 +145,9 @@ class FrontendConfig:
     # TLS termination (ref frontend --tls-cert-path/--tls-key-path).
     tls_cert: Optional[str] = None
     tls_key: Optional[str] = None
+    # Multimodal: route image parts to the encode-worker pool at this
+    # component (ref: trtllm encode_helper.py); None = images rejected.
+    encode_component: Optional[str] = None
 
 
 async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> HttpService:
@@ -145,8 +175,15 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
             if config.busy_threshold is not None:
                 router.monitor.busy_threshold = config.busy_threshold
         tokenizer = load_tokenizer(entry.card.tokenizer_path)
+        encode_client = None
+        if config.encode_component:
+            enc_ep = drt.namespace(entry.namespace).component(config.encode_component).endpoint(
+                entry.endpoint
+            )
+            encode_client = PushRouter(await enc_ep.client(), RouterMode.ROUND_ROBIN)
         return build_routed_pipeline(
-            tokenizer, router, entry.card, migration_limit=config.migration_limit
+            tokenizer, router, entry.card, migration_limit=config.migration_limit,
+            encode_client=encode_client,
         )
 
     watcher = ModelWatcher(drt, manager, engine_factory)

@@ -59,6 +59,9 @@ class GgufMetadata:
     version: int
     metadata: Dict[str, Any] = field(default_factory=dict)
     tensors: List[GgufTensorInfo] = field(default_factory=list)
+    # Absolute file offset of the (aligned) tensor-data section; tensor
+    # offsets are relative to this.
+    data_start: int = 0
 
     # --- convenience accessors the MDC builder uses -------------------------
     @property
@@ -153,4 +156,69 @@ def parse_gguf(path: str, *, max_array: int = 1 << 24) -> GgufMetadata:
             ggml_type = _read_scalar(f, "<I")
             offset = _read_scalar(f, "<Q")
             meta.tensors.append(GgufTensorInfo(name=name, shape=shape, ggml_type=ggml_type, offset=offset))
+        align = int(meta.metadata.get("general.alignment", 32) or 32)
+        pos = f.tell()
+        meta.data_start = (pos + align - 1) // align * align
         return meta
+
+
+# --- tensor data loading ----------------------------------------------------
+# Real-valued + q8_0 coverage: what llama.cpp emits for f32/f16/bf16 exports
+# and the simplest quantized format. Other quants raise (convert externally).
+
+GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+
+
+def _tensor_nbytes(info: GgufTensorInfo) -> int:
+    import math
+
+    n = math.prod(info.shape) if info.shape else 1
+    if info.ggml_type in (GGML_F16, GGML_BF16):
+        return n * 2
+    if info.ggml_type == GGML_F32:
+        return n * 4
+    if info.ggml_type == GGML_Q8_0:
+        if n % 32:
+            raise GgufError(f"{info.name}: q8_0 needs multiple-of-32 elements")
+        return (n // 32) * 34  # f16 scale + 32 int8 codes per block
+    raise GgufError(
+        f"{info.name}: unsupported tensor dtype {info.dtype_name} "
+        "(supported: f32, f16, bf16, q8_0)"
+    )
+
+
+def read_tensor(f: BinaryIO, meta: GgufMetadata, info: GgufTensorInfo):
+    """Read one tensor as float32 numpy, shaped with ggml's ne reversed
+    (ne[0] is the contiguous dim), i.e. matrices come out HF-style
+    ``[out, in]``."""
+    import numpy as np
+
+    f.seek(meta.data_start + info.offset)
+    raw = _read(f, _tensor_nbytes(info))
+    shape = tuple(reversed(info.shape)) if info.shape else ()
+    if info.ggml_type == GGML_F32:
+        arr = np.frombuffer(raw, dtype=np.float32)
+    elif info.ggml_type == GGML_F16:
+        arr = np.frombuffer(raw, dtype=np.float16).astype(np.float32)
+    elif info.ggml_type == GGML_BF16:
+        u = np.frombuffer(raw, dtype=np.uint16).astype(np.uint32) << 16
+        arr = u.view(np.float32)
+    else:  # q8_0
+        blocks = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 34)
+        scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)  # [nb, 1]
+        codes = blocks[:, 2:].copy().view(np.int8).astype(np.float32)  # [nb, 32]
+        arr = (codes * scales).reshape(-1)
+    return arr.reshape(shape)
+
+
+def load_tensors(path: str, names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Load (a subset of) a GGUF file's tensors as f32 numpy arrays."""
+    meta = parse_gguf(path)
+    want = set(names) if names is not None else None
+    out: Dict[str, Any] = {}
+    with open(path, "rb") as f:
+        for info in meta.tensors:
+            if want is not None and info.name not in want:
+                continue
+            out[info.name] = read_tensor(f, meta, info)
+    return out

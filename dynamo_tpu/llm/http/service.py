@@ -82,6 +82,13 @@ class HttpService:
         self._m_duration = lambda model: m.histogram(
             "request_duration_seconds", "request duration", buckets=DURATION_BUCKETS, model=model
         )
+        # Engine-admission queue time (ref: http_queue_guard / queue-time
+        # histograms in http/service/metrics.rs) — the saturation signal the
+        # SLA planner inverts for prefill replica math.
+        self._m_queue = lambda model: m.histogram(
+            "queue_time_seconds", "request queue time before engine admission",
+            buckets=TTFT_BUCKETS, model=model,
+        )
         self._m_output_tokens = lambda model: m.counter("output_tokens_total", "output tokens", model=model)
         self._m_input_tokens = lambda model: m.counter("input_tokens_total", "input (prompt) tokens", model=model)
 
@@ -238,36 +245,184 @@ class HttpService:
             return web.json_response(oai.error_body(f"model {model!r} not found", "model_not_found", 404), status=404)
         rid = oai.make_id("resp")
 
+        try:
+            messages = oai.responses_input_to_messages(body)  # RequestError on bad items
+        except oai.RequestError as e:
+            self._m_requests(model, "400").inc()
+            return web.json_response(oai.error_body(str(e)), status=400)
+        chat_body = {
+            "model": model,
+            "messages": messages,
+            "stream": False,
+        }
+        for key in ("temperature", "top_p", "max_output_tokens"):
+            if body.get(key) is not None:
+                chat_body["max_tokens" if key == "max_output_tokens" else key] = body[key]
+        if body.get("tools"):
+            chat_body["tools"] = oai.responses_tools_to_chat(body["tools"])
+
+        if body.get("stream"):
+            return await self._responses_stream(request, engine, chat_body, rid, model)
+
         async def handle():
-            if body.get("stream"):
-                raise oai.RequestError("stream=true is not yet supported on /v1/responses")
-            chat_body = {
-                "model": model,
-                "messages": oai.responses_input_to_messages(body),  # RequestError on bad items
-                "stream": False,
-            }
-            for key in ("temperature", "top_p", "max_output_tokens"):
-                if body.get(key) is not None:
-                    chat_body["max_tokens" if key == "max_output_tokens" else key] = body[key]
             text_parts, n_tokens, prompt_tokens = [], 0, 0
+            tool_calls = None
             async for item in engine.generate(chat_body, Context()):
                 if isinstance(item, Annotated) and item.is_annotation():
                     if item.event == "_metrics":
                         prompt_tokens = int(item.comment or 0)
                         self._m_input_tokens(model).inc(prompt_tokens)
+                    elif item.event == "_queue":
+                        self._m_queue(model).observe(float(item.comment or 0))
                     continue
                 out = _as_output(item)
                 if out is None:
                     continue
                 if out.text:
                     text_parts.append(out.text)
+                if out.tool_calls:
+                    tool_calls = out.tool_calls
                 n_tokens += len(out.token_ids)
             self._m_output_tokens(model).inc(n_tokens)
             usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
-            return web.json_response(oai.responses_response(rid, model, "".join(text_parts), usage))
+            return web.json_response(
+                oai.responses_response(rid, model, "".join(text_parts), usage, tool_calls=tool_calls)
+            )
 
         async with self._unary_envelope(model) as scope:
             return await scope.run(handle)
+
+    async def _responses_stream(
+        self, request: web.Request, engine, chat_body: dict, rid: str, model: str
+    ) -> web.StreamResponse:
+        """Responses-API semantic SSE stream (ref: openai.rs:714,
+        protocols/openai/responses.rs): response.created →
+        output_item.added → content_part.added → output_text.delta* →
+        *.done → (function_call items) → response.completed."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
+        seq = [0]
+        start = time.monotonic()
+
+        async def emit(etype: str, payload: dict) -> None:
+            payload = {"type": etype, "sequence_number": seq[0], **payload}
+            seq[0] += 1
+            await resp.write(
+                b"event: " + etype.encode()
+                + b"\ndata: " + json.dumps(payload, ensure_ascii=False).encode() + b"\n\n"
+            )
+
+        text_parts: list = []
+        tool_calls = None
+        n_tokens, prompt_tokens = 0, 0
+        status = "200"
+        msg_id = f"msg-{rid}"
+        msg_started = False
+
+        async def ensure_message_started() -> None:
+            # The message output item opens lazily at the first text delta:
+            # tool-call-only responses must match the unary shape (no empty
+            # message item; function_call items start at output_index 0).
+            nonlocal msg_started
+            if msg_started:
+                return
+            msg_started = True
+            await emit(
+                "response.output_item.added",
+                {"output_index": 0, "item": {"type": "message", "id": msg_id, "role": "assistant",
+                                             "status": "in_progress", "content": []}},
+            )
+            await emit(
+                "response.content_part.added",
+                {"item_id": msg_id, "output_index": 0, "content_index": 0,
+                 "part": {"type": "output_text", "text": "", "annotations": []}},
+            )
+
+        self._m_inflight(model).inc()
+        try:
+            await emit("response.created", {"response": oai.responses_envelope(rid, model, [], status="in_progress")})
+            await emit("response.in_progress", {"response": oai.responses_envelope(rid, model, [], status="in_progress")})
+            async for item in engine.generate(chat_body, ctx):
+                if isinstance(item, Annotated) and item.is_annotation():
+                    if item.event == "_metrics":
+                        prompt_tokens = int(item.comment or 0)
+                        self._m_input_tokens(model).inc(prompt_tokens)
+                    elif item.event == "_queue":
+                        self._m_queue(model).observe(float(item.comment or 0))
+                    continue
+                out = _as_output(item)
+                if out is None:
+                    continue
+                n_tokens += len(out.token_ids)
+                if out.text:
+                    await ensure_message_started()
+                    text_parts.append(out.text)
+                    await emit(
+                        "response.output_text.delta",
+                        {"item_id": msg_id, "output_index": 0, "content_index": 0, "delta": out.text},
+                    )
+                if out.tool_calls:
+                    tool_calls = out.tool_calls
+            text = "".join(text_parts)
+            output = []
+            if msg_started or not tool_calls:
+                await ensure_message_started()
+                await emit(
+                    "response.output_text.done",
+                    {"item_id": msg_id, "output_index": 0, "content_index": 0, "text": text},
+                )
+                await emit(
+                    "response.content_part.done",
+                    {"item_id": msg_id, "output_index": 0, "content_index": 0,
+                     "part": {"type": "output_text", "text": text, "annotations": []}},
+                )
+                output.append(oai.responses_message_item(rid, text))
+                await emit("response.output_item.done", {"output_index": 0, "item": output[0]})
+            for i, call in enumerate(tool_calls or []):
+                idx = len(output)
+                fc = oai.responses_function_call_item(rid, i, call)
+                output.append(fc)
+                await emit(
+                    "response.output_item.added",
+                    {"output_index": idx, "item": {**fc, "arguments": "", "status": "in_progress"}},
+                )
+                await emit(
+                    "response.function_call_arguments.delta",
+                    {"item_id": fc["id"], "output_index": idx, "delta": fc["arguments"]},
+                )
+                await emit(
+                    "response.function_call_arguments.done",
+                    {"item_id": fc["id"], "output_index": idx, "arguments": fc["arguments"]},
+                )
+                await emit("response.output_item.done", {"output_index": idx, "item": fc})
+            usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
+            await emit(
+                "response.completed",
+                {"response": oai.responses_envelope(rid, model, output, usage)},
+            )
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.stop_generating()
+            status = "499"
+            raise
+        except Exception as e:  # noqa: BLE001 — stream errors become SSE error events
+            logger.exception("responses stream %s failed", ctx.id)
+            status = "500"
+            await emit("error", {"message": str(e)})
+        finally:
+            self._m_inflight(model).dec()
+            self._m_duration(model).observe(time.monotonic() - start)
+            self._m_requests(model, status).inc()
+            self._m_output_tokens(model).inc(n_tokens)
+        await resp.write_eof()
+        return resp
 
     # --- core serving path --------------------------------------------------
     async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
@@ -299,6 +454,11 @@ class HttpService:
             if stream:
                 return await self._serve_stream(request, engine, body, ctx, rid, kind, model, start)
             return await self._serve_unary(engine, body, ctx, rid, kind, model, start)
+        except oai.RequestError as e:
+            # Pipeline-stage rejection (e.g. image parts with no encode
+            # path): a client/deployment-configuration 400, not a 500.
+            self._m_requests(model, "400").inc()
+            return web.json_response(oai.error_body(str(e)), status=400)
         finally:
             self._m_inflight(model).dec()
             self._m_duration(model).observe(time.monotonic() - start)
@@ -337,6 +497,8 @@ class HttpService:
                     if item.event == "_metrics" and i == 0:
                         prompt_tokens_box[0] = int(item.comment or 0)
                         self._m_input_tokens(model).inc(prompt_tokens_box[0])
+                    elif item.event == "_queue" and i == 0:
+                        self._m_queue(model).observe(float(item.comment or 0))
                     continue
                 out = _as_output(item)
                 if out is None:
@@ -383,6 +545,11 @@ class HttpService:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+            if isinstance(e, oai.RequestError):
+                # Pipeline-stage rejection (e.g. image parts with no encode
+                # path): a client/configuration 400, not a server fault.
+                self._m_requests(model, "400").inc()
+                return web.json_response(oai.error_body(str(e)), status=400)
             logger.exception("request %s failed", ctx.id)
             self._m_requests(model, "500").inc()
             return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
@@ -433,6 +600,8 @@ class HttpService:
                     if item.event.startswith("_"):
                         if item.event == "_metrics":
                             self._m_input_tokens(model).inc(int(item.comment or 0))
+                        elif item.event == "_queue":
+                            self._m_queue(model).observe(float(item.comment or 0))
                         continue
                     await _sse_event(resp, item.event, item.comment)
                     continue
@@ -520,6 +689,8 @@ class HttpService:
                     if isinstance(item, Annotated) and item.is_annotation():
                         if item.event == "_metrics" and i == 0:
                             self._m_input_tokens(model).inc(int(item.comment or 0))
+                        elif item.event == "_queue" and i == 0:
+                            self._m_queue(model).observe(float(item.comment or 0))
                         continue
                     out = _as_output(item)
                     if out is not None:

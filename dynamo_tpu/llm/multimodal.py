@@ -1,0 +1,185 @@
+"""Multimodal serving: image content parts → encode worker → prefill.
+
+Ref: the trtllm encode-worker flow (components/backends/trtllm/src/dynamo/
+trtllm/utils/encode_helper.py) and the image paths in the vllm/sglang
+adapters. Topology mirrors the reference's disagg pattern:
+
+    frontend → preprocessor → [EncodeOperator] → LM worker
+                                   │ images
+                                   ▼
+                              encode worker (ViT, its own chip pool)
+
+- :func:`extract_images` pulls ``image_url`` content parts out of chat
+  messages (data: URLs — the zero-egress environment has no fetch path)
+  and flattens the remaining text for the chat template.
+- :class:`EncodeWorkerHandler` is the encode worker's endpoint: decodes +
+  resizes images, runs the JAX ViT (engine/models/vision.py), and returns
+  features (wire: base64 f32; in-process: the array itself).
+- :class:`EncodeOperator` is the frontend-side pipeline stage: when a
+  request carries images it obtains features (local encoder or remote
+  encode worker), prepends one placeholder token per feature row to
+  ``token_ids``, and attaches the features for the engine to inject at
+  those positions (llama.prefill ``mm_feats``).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Any, AsyncIterator, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.pipeline import Operator
+
+logger = get_logger(__name__)
+
+# Placeholder token id occupying image-feature positions in the prompt.
+# Position bookkeeping (KV blocks, usage accounting) sees ordinary tokens;
+# prefill overrides their embeddings with the feature rows.
+IMAGE_PLACEHOLDER_TOKEN = 0
+
+
+def decode_image_data_url(url: str, size: int) -> np.ndarray:
+    """data:image/...;base64,... → [size, size, 3] f32 in [0, 1]."""
+    if not url.startswith("data:"):
+        raise ValueError(
+            "only data: image URLs are supported (zero-egress environment)"
+        )
+    try:
+        b64 = url.split(",", 1)[1]
+        raw = base64.b64decode(b64)
+    except (IndexError, ValueError) as e:
+        raise ValueError(f"malformed image data URL: {e}") from None
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB").resize((size, size))
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def extract_images(messages: List[dict]) -> Tuple[List[dict], List[str]]:
+    """Split image_url parts out of chat messages. Returns (messages with
+    flattened text content, image URLs in order of appearance)."""
+    out, urls = [], []
+    for msg in messages:
+        content = msg.get("content")
+        if not isinstance(content, list):
+            out.append(msg)
+            continue
+        texts = []
+        for part in content:
+            if not isinstance(part, dict):
+                continue
+            ptype = part.get("type")
+            if ptype == "image_url":
+                url = (part.get("image_url") or {}).get("url")
+                if not url:
+                    raise ValueError("image_url part missing url")
+                urls.append(url)
+            elif ptype in ("text", "input_text"):
+                texts.append(part.get("text", ""))
+        out.append({**msg, "content": "".join(texts)})
+    return out, urls
+
+
+def features_to_wire(features: np.ndarray) -> dict:
+    f = np.ascontiguousarray(features, dtype=np.float32)
+    return {
+        "features_b64": base64.b64encode(f.tobytes()).decode(),
+        "shape": list(f.shape),
+    }
+
+
+def features_from_wire(d: dict) -> np.ndarray:
+    raw = base64.b64decode(d["features_b64"])
+    return np.frombuffer(raw, dtype=np.float32).reshape(d["shape"]).copy()
+
+
+class LocalVisionEncoder:
+    """In-process ViT (testing / aggregated single-host serving)."""
+
+    def __init__(self, config=None, params=None, *, preset: str = "tiny-vit", seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine.models import vision
+
+        self.config = config or vision.PRESETS[preset]
+        self.params = params if params is not None else vision.init_params(
+            self.config, jax.random.PRNGKey(seed)
+        )
+        self._encode = jax.jit(lambda p, imgs: vision.encode(p, self.config, imgs))
+        self._jnp = jnp
+
+    def encode_urls(self, urls: List[str]) -> np.ndarray:
+        """Image URLs → stacked features [n_images * P, lm_hidden] f32."""
+        imgs = np.stack(
+            [decode_image_data_url(u, self.config.image_size) for u in urls]
+        )
+        feats = self._encode(self.params, self._jnp.asarray(imgs))
+        return np.asarray(feats).reshape(-1, self.config.lm_hidden_size)
+
+
+class EncodeWorkerHandler:
+    """Encode worker endpoint (AsyncEngine shape): request
+    ``{"image_urls": [...]}`` → one frame ``{"features_b64", "shape"}``.
+    Serve with ``endpoint.serve_endpoint(handler.generate)``."""
+
+    def __init__(self, encoder: Optional[LocalVisionEncoder] = None):
+        self.encoder = encoder or LocalVisionEncoder()
+        self.requests_total = 0
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        import asyncio
+
+        urls = list(request.get("image_urls") or [])
+        if not urls:
+            raise ValueError("encode request carries no image_urls")
+        self.requests_total += 1
+        feats = await asyncio.to_thread(self.encoder.encode_urls, urls)
+        yield features_to_wire(feats)
+
+    def stats_handler(self) -> dict:
+        return {"requests_total": self.requests_total}
+
+
+class EncodeOperator(Operator):
+    """Frontend-side stage bridging image parts to the encode worker.
+
+    ``encoder`` (local) or ``client`` (PushRouter/Client to the encode
+    worker's endpoint) — exactly one. The preprocessor upstream has already
+    extracted images into ``request["_mm_image_urls"]``."""
+
+    def __init__(self, encoder: Optional[LocalVisionEncoder] = None, client=None):
+        if (encoder is None) == (client is None):
+            raise ValueError("EncodeOperator needs exactly one of encoder|client")
+        self.encoder = encoder
+        self.client = client
+
+    async def transform_request(self, request: dict, context: Context) -> dict:
+        urls = request.pop("_mm_image_urls", None)
+        if not urls:
+            return request
+        if self.encoder is not None:
+            import asyncio
+
+            feats = await asyncio.to_thread(self.encoder.encode_urls, urls)
+        else:
+            wire = None
+            async for frame in self.client.generate({"image_urls": urls}, context):
+                data = frame.data if hasattr(frame, "data") else frame
+                if isinstance(data, dict) and "features_b64" in data:
+                    wire = data
+            if wire is None:
+                raise RuntimeError("encode worker returned no features")
+            feats = features_from_wire(wire)
+        request = dict(request)
+        # One placeholder token per feature row, PREPENDED (vision-prefix
+        # early fusion): positions [0, F) carry the image, text follows.
+        request["token_ids"] = [IMAGE_PLACEHOLDER_TOKEN] * feats.shape[0] + list(
+            request.get("token_ids") or []
+        )
+        request["multimodal"] = features_to_wire(feats)
+        return request

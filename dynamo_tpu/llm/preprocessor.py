@@ -117,8 +117,16 @@ class OpenAIPreprocessor(Operator):
 
     # --- core ---------------------------------------------------------------
     def preprocess(self, body: dict) -> PreprocessedRequest:
+        image_urls: List[str] = []
         if "messages" in body:
-            prompt = self.formatter.render(body["messages"], add_generation_prompt=True)
+            messages = body["messages"]
+            if any(isinstance(m.get("content"), list) for m in messages):
+                # Image content parts → encode worker (multimodal.py); the
+                # template renders the flattened text.
+                from dynamo_tpu.llm.multimodal import extract_images
+
+                messages, image_urls = extract_images(messages)
+            prompt = self.formatter.render(messages, add_generation_prompt=True)
             token_ids = self.tokenizer.encode(prompt)
         else:
             raw = body.get("prompt", "")
@@ -139,4 +147,5 @@ class OpenAIPreprocessor(Operator):
             annotations=list(nvext.get("annotations") or []),
             model=body.get("model", ""),
             router_overrides=nvext.get("router") or {},
+            image_urls=image_urls,
         ), prompt

@@ -61,9 +61,12 @@ class PreprocessedRequest:
     router_overrides: Dict[str, Any] = field(default_factory=dict)
     # Disaggregation: set by the decode worker when forwarding to prefill.
     disagg_params: Dict[str, Any] = field(default_factory=dict)
+    # Multimodal: image data URLs extracted from chat content parts; the
+    # EncodeOperator (multimodal.py) turns them into embedding features.
+    image_urls: List[str] = field(default_factory=list)
 
     def to_wire(self) -> dict:
-        return {
+        d = {
             "token_ids": self.token_ids,
             "sampling_options": self.sampling_options,
             "stop_conditions": self.stop_conditions,
@@ -72,6 +75,9 @@ class PreprocessedRequest:
             "router_overrides": self.router_overrides,
             "disagg_params": self.disagg_params,
         }
+        if self.image_urls:
+            d["_mm_image_urls"] = self.image_urls
+        return d
 
     @classmethod
     def from_wire(cls, d: dict) -> "PreprocessedRequest":

@@ -358,25 +358,71 @@ def responses_input_to_messages(body: dict) -> list:
     return messages
 
 
-def responses_response(rid: str, model: str, text: str, usage: dict, status: str = "completed") -> dict:
+def responses_tools_to_chat(tools: Optional[list]) -> list:
+    """Responses-API tool definitions (flat ``{type:'function', name, ...}``)
+    → chat-completions shape (``{type, function:{...}}``). Chat-shaped items
+    pass through unchanged."""
+    out = []
+    for t in tools or []:
+        if not isinstance(t, dict):
+            continue
+        if isinstance(t.get("function"), dict):
+            out.append(t)
+        elif t.get("type") == "function" and t.get("name"):
+            fn = {k: t[k] for k in ("name", "description", "parameters", "strict") if k in t}
+            out.append({"type": "function", "function": fn})
+    return out
+
+
+def responses_message_item(rid: str, text: str, status: str = "completed") -> dict:
+    return {
+        "type": "message",
+        "id": f"msg-{rid}",
+        "role": "assistant",
+        "status": status,
+        "content": [{"type": "output_text", "text": text, "annotations": []}],
+    }
+
+
+def responses_function_call_item(rid: str, idx: int, call: dict) -> dict:
+    """Chat tool_call dict → Responses function_call output item."""
+    fn = call.get("function") or {}
+    return {
+        "type": "function_call",
+        "id": f"fc-{rid}-{idx}",
+        "call_id": call.get("id") or f"call-{rid}-{idx}",
+        "name": fn.get("name", ""),
+        "arguments": fn.get("arguments", ""),
+        "status": "completed",
+    }
+
+
+def responses_envelope(
+    rid: str, model: str, output: list, usage: Optional[dict] = None, status: str = "completed"
+) -> dict:
+    usage = usage or {}
     return {
         "id": rid,
         "object": "response",
         "created_at": int(time.time()),
         "model": model,
         "status": status,
-        "output": [
-            {
-                "type": "message",
-                "id": f"msg-{rid}",
-                "role": "assistant",
-                "status": status,
-                "content": [{"type": "output_text", "text": text, "annotations": []}],
-            }
-        ],
+        "output": output,
         "usage": {
             "input_tokens": usage.get("prompt_tokens", 0),
             "output_tokens": usage.get("completion_tokens", 0),
             "total_tokens": usage.get("total_tokens", 0),
         },
     }
+
+
+def responses_response(
+    rid: str, model: str, text: str, usage: dict, status: str = "completed",
+    tool_calls: Optional[list] = None,
+) -> dict:
+    output = []
+    if text or not tool_calls:
+        output.append(responses_message_item(rid, text, status))
+    for i, call in enumerate(tool_calls or []):
+        output.append(responses_function_call_item(rid, i, call))
+    return responses_envelope(rid, model, output, usage, status)

@@ -44,7 +44,9 @@ class MetricsAggregator:
                 g_workers.set(len(stats))
                 for wid, s in stats.items():
                     labels = {"worker": f"{wid:x}"}
-                    for key in ("kv_usage", "num_running", "num_waiting", "in_flight", "remote_prefills", "local_prefills"):
+                    for key in ("kv_usage", "num_running", "num_waiting", "in_flight",
+                                "remote_prefills", "local_prefills",
+                                "moe_dropped_total", "moe_assignments_total"):
                         if key in s:
                             self.registry.gauge(f"worker_{key}", f"worker {key}", **labels).set(float(s[key]))
                 await asyncio.sleep(self.interval_s)

@@ -72,35 +72,69 @@ class LocalConnector(Connector):
 
 
 class KubernetesConnector(Connector):
-    """kubectl-scale connector (ref: kubernetes_connector.py → kube.py).
-    Requires kubectl in PATH and a deployment per component."""
+    """kubectl connector (ref: kubernetes_connector.py → kube.py).
 
-    def __init__(self, namespace: str = "default", deployment_fmt: str = "dynamo-{component}"):
-        if shutil.which("kubectl") is None:
+    Two modes:
+    - ``graph`` set: scales the DynamoGraphDeployment CR's per-service
+      replicas (``kubectl patch dgd/<graph> --type=merge``) — an
+      in-cluster controller reconciles (deploy/crd.py schema).
+    - otherwise: scales rendered Deployments directly
+      (``kubectl scale deployment/<fmt>``) — the controller-less
+      manifests.py path.
+
+    ``kubectl_cmd`` injects the binary (tests use a stub; ``--dry-run``
+    flows through to validate apply-ability without a cluster)."""
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        deployment_fmt: str = "dynamo-{component}",
+        *,
+        graph: Optional[str] = None,
+        kubectl_cmd: Optional[List[str]] = None,
+        extra_args: Optional[List[str]] = None,
+    ):
+        self.kubectl = list(kubectl_cmd) if kubectl_cmd else ["kubectl"]
+        if kubectl_cmd is None and shutil.which("kubectl") is None:
             raise RuntimeError("kubectl not found in PATH")
         self.namespace = namespace
         self.deployment_fmt = deployment_fmt
+        self.graph = graph
+        self.extra_args = list(extra_args or [])
 
     def _name(self, component: str) -> str:
         return self.deployment_fmt.format(component=component)
 
-    async def set_replicas(self, component: str, replicas: int) -> None:
-        cmd = [
-            "kubectl", "-n", self.namespace, "scale", f"deployment/{self._name(component)}",
-            f"--replicas={replicas}",
-        ]
-        proc = await asyncio.create_subprocess_exec(*cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-        _, err = await proc.communicate()
-        if proc.returncode != 0:
-            raise RuntimeError(f"kubectl scale failed: {err.decode()}")
-
-    async def get_replicas(self, component: str) -> int:
-        cmd = [
-            "kubectl", "-n", self.namespace, "get", f"deployment/{self._name(component)}",
-            "-o", "jsonpath={.spec.replicas}",
-        ]
-        proc = await asyncio.create_subprocess_exec(*cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    async def _kubectl(self, *args: str) -> str:
+        cmd = [*self.kubectl, "-n", self.namespace, *args, *self.extra_args]
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+        )
         out, err = await proc.communicate()
         if proc.returncode != 0:
-            raise RuntimeError(f"kubectl get failed: {err.decode()}")
-        return int(out.decode().strip() or 0)
+            raise RuntimeError(f"{' '.join(cmd[:3])}… failed: {err.decode().strip()}")
+        return out.decode()
+
+    async def set_replicas(self, component: str, replicas: int) -> None:
+        if self.graph:
+            patch = json.dumps({"spec": {"services": {component: {"replicas": replicas}}}})
+            await self._kubectl(
+                "patch", f"dynamographdeployments.dynamo.tpu.io/{self.graph}",
+                "--type=merge", "-p", patch,
+            )
+        else:
+            await self._kubectl(
+                "scale", f"deployment/{self._name(component)}", f"--replicas={replicas}"
+            )
+
+    async def get_replicas(self, component: str) -> int:
+        if self.graph:
+            out = await self._kubectl(
+                "get", f"dynamographdeployments.dynamo.tpu.io/{self.graph}",
+                "-o", f"jsonpath={{.spec.services.{component}.replicas}}",
+            )
+        else:
+            out = await self._kubectl(
+                "get", f"deployment/{self._name(component)}", "-o", "jsonpath={.spec.replicas}"
+            )
+        return int(out.strip() or 0)

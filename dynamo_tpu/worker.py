@@ -35,7 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default=None, help="defaults to role name")
     p.add_argument("--endpoint", default="generate")
-    p.add_argument("--role", choices=["aggregated", "decode", "prefill"], default="aggregated")
+    p.add_argument("--role", choices=["aggregated", "decode", "prefill", "encode"], default="aggregated")
+    p.add_argument("--vision-model", default="tiny-vit",
+                   help="vision tower preset for --role encode (engine/models/vision.py)")
+    p.add_argument("--vision-seed", type=int, default=0)
     p.add_argument("--mocker", action="store_true", help="serve the mocker engine instead of the JAX engine")
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--max-running", type=int, default=16)
@@ -77,6 +80,21 @@ def build_parser() -> argparse.ArgumentParser:
 async def amain(args) -> None:
     drt = await DistributedRuntime.from_settings()
     drt.runtime.install_signal_handlers()
+
+    if args.role == "encode":
+        # Multimodal encode worker (ref: trtllm encode_helper.py): serves
+        # image → embedding features for the LM pool's prefill injection.
+        from dynamo_tpu.llm.multimodal import EncodeWorkerHandler, LocalVisionEncoder
+
+        handler = EncodeWorkerHandler(LocalVisionEncoder(preset=args.vision_model, seed=args.vision_seed))
+        ep = drt.namespace(args.namespace).component("encode").endpoint(args.endpoint)
+        handle = await ep.serve_endpoint(handler.generate, stats_handler=handler.stats_handler)
+        logger.info("encode worker ready: vision=%s instance=%x", args.vision_model, handle.instance.instance_id)
+        try:
+            await drt.runtime.cancellation.cancelled()
+        finally:
+            await drt.shutdown()
+        return
 
     if args.num_processes > 1:
         # Join the multi-controller runtime BEFORE any jax backend touch.

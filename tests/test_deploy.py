@@ -27,7 +27,7 @@ services:
     command: [python, -m, dynamo_tpu.worker, --model, llama-3-8b]
     replicas: 2
     resources: {tpu_chips: 4, memory: 32Gi}
-    env: {BENCH_ATTN: paged_kernel}
+    env: {BENCH_ATTN: gather}
 """
 
 
@@ -67,7 +67,7 @@ def test_render_manifests():
     ] == "tpu-v5-lite-podslice"
     assert decode["spec"]["replicas"] == 2
     env = {e["name"]: e["value"] for e in c["env"]}
-    assert env["DYN_NAMESPACE"] == "prod" and env["BENCH_ATTN"] == "paged_kernel"
+    assert env["DYN_NAMESPACE"] == "prod" and env["BENCH_ATTN"] == "gather"
 
     docs = list(yaml.safe_load_all(render_yaml(g)))
     assert len(docs) == len(render_manifests(g))
@@ -134,3 +134,73 @@ async def test_operator_crash_loop_marks_degraded():
         assert st["degraded"] and st["live"] == 0  # backs off, stops respawning
     finally:
         await op.shutdown()
+
+
+def test_crd_and_cr_roundtrip():
+    """Graph → DynamoGraphDeployment CR → graph survives unchanged; the
+    CRD schema covers every field the CR uses (ref:
+    deploy/cloud/operator/api/v1alpha1)."""
+    from dynamo_tpu.deploy.crd import cr_to_graph, crd_manifest, graph_to_cr, render_cluster_yaml
+
+    graph = GraphDeployment.from_yaml(GRAPH_YAML)
+    cr = graph_to_cr(graph)
+    assert cr["kind"] == "DynamoGraphDeployment"
+    assert cr["metadata"] == {"name": "tiny-disagg", "namespace": "prod"}
+    back = cr_to_graph(cr)
+    assert back.to_dict() == graph.to_dict()
+
+    crd = crd_manifest()
+    assert crd["kind"] == "CustomResourceDefinition"
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    svc_schema = schema["properties"]["spec"]["properties"]["services"]["additionalProperties"]
+    for svc in cr["spec"]["services"].values():
+        for key in svc:
+            assert key in svc_schema["properties"], f"CR field {key} missing from CRD schema"
+
+    docs = list(yaml.safe_load_all(render_cluster_yaml(graph)))
+    assert [d["kind"] for d in docs] == ["CustomResourceDefinition", "DynamoGraphDeployment"]
+
+
+async def test_kubernetes_connector_cr_scaling(tmp_path):
+    """KubernetesConnector in CR mode issues the merge-patch an in-cluster
+    controller reconciles; verified against a stub kubectl that records
+    its argv (kubectl-apply dry-run discipline without a cluster)."""
+    import json as _json
+
+    from dynamo_tpu.planner.connectors import KubernetesConnector
+
+    log = tmp_path / "kubectl.log"
+    stub = tmp_path / "kubectl"
+    stub.write_text(
+        "#!/bin/sh\n"
+        # printf, not echo: the first kubectl arg is "-n", which echo eats
+        # as its no-newline flag.
+        f"printf '%s\\n' \"$*\" >> {log}\n"
+        'case "$*" in *jsonpath*) printf 3;; esac\n'
+    )
+    stub.chmod(0o755)
+
+    conn = KubernetesConnector(
+        namespace="prod", graph="tiny-disagg", kubectl_cmd=[str(stub)],
+        extra_args=["--dry-run=client"],
+    )
+    await conn.set_replicas("decode", 5)
+    assert await conn.get_replicas("decode") == 3
+    lines = log.read_text().splitlines()
+    assert "patch dynamographdeployments.dynamo.tpu.io/tiny-disagg" in lines[0]
+    assert "--dry-run=client" in lines[0]
+    patch = _json.loads(lines[0].split("-p ", 1)[1].rsplit(" --dry-run", 1)[0])
+    assert patch == {"spec": {"services": {"decode": {"replicas": 5}}}}
+    assert "get dynamographdeployments.dynamo.tpu.io/tiny-disagg" in lines[1]
+
+
+async def test_kubernetes_connector_deployment_scaling(tmp_path):
+    from dynamo_tpu.planner.connectors import KubernetesConnector
+
+    log = tmp_path / "kubectl.log"
+    stub = tmp_path / "kubectl"
+    stub.write_text("#!/bin/sh\n" f"printf '%s\\n' \"$*\" >> {log}\n")
+    stub.chmod(0o755)
+    conn = KubernetesConnector(namespace="ns", kubectl_cmd=[str(stub)])
+    await conn.set_replicas("decode", 2)
+    assert "scale deployment/dynamo-decode --replicas=2" in log.read_text()

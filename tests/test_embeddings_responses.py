@@ -105,7 +105,7 @@ async def test_responses_endpoint():
         await engine.stop()
 
 
-async def test_responses_rejects_bad_items_and_stream():
+async def test_responses_rejects_bad_items():
     service, engine = await make_service()
     try:
         async with aiohttp.ClientSession() as s:
@@ -114,9 +114,74 @@ async def test_responses_rejects_bad_items_and_stream():
             async with s.post(url, json={"model": MODEL, "input": [42]}) as r:
                 assert r.status == 400
                 assert "error" in await r.json()
-            # stream=true → explicit 400 until SSE is implemented.
-            async with s.post(url, json={"model": MODEL, "input": "x", "stream": True}) as r:
-                assert r.status == 400
     finally:
         await service.stop()
         await engine.stop()
+
+
+async def test_responses_streaming_contract():
+    """Semantic SSE event sequence (ref: openai.rs:714): created →
+    output_item.added → content_part.added → output_text.delta* →
+    output_text.done → content_part.done → output_item.done → completed;
+    deltas reassemble to the final text; sequence numbers monotone."""
+    import json as _json
+
+    service, engine = await make_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{service.port}/v1/responses"
+            body = {"model": MODEL, "input": "stream me", "max_output_tokens": 5, "stream": True}
+            events = []
+            async with s.post(url, json=body) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for line in r.content:
+                    if line.startswith(b"data:"):
+                        events.append(_json.loads(line[5:]))
+        types = [e["type"] for e in events]
+        assert types[0] == "response.created"
+        for required in (
+            "response.output_item.added", "response.content_part.added",
+            "response.output_text.delta", "response.output_text.done",
+            "response.content_part.done", "response.output_item.done",
+            "response.completed",
+        ):
+            assert required in types, f"missing {required} in {types}"
+        assert [e["sequence_number"] for e in events] == list(range(len(events)))
+        deltas = "".join(e["delta"] for e in events if e["type"] == "response.output_text.delta")
+        done = next(e for e in events if e["type"] == "response.output_text.done")
+        assert deltas == done["text"] and deltas
+        completed = next(e for e in events if e["type"] == "response.completed")
+        assert completed["response"]["status"] == "completed"
+        assert completed["response"]["usage"]["output_tokens"] == 5
+        assert completed["response"]["output"][0]["content"][0]["text"] == deltas
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def test_responses_tools_mapping():
+    """Responses tool defs map to chat shape; tool_calls come back as
+    function_call output items (unary + streamed)."""
+    from dynamo_tpu.llm.protocols import openai as oai
+
+    chat_tools = oai.responses_tools_to_chat(
+        [{"type": "function", "name": "get_weather", "parameters": {"type": "object"}}]
+    )
+    assert chat_tools == [
+        {"type": "function", "function": {"name": "get_weather", "parameters": {"type": "object"}}}
+    ]
+    item = oai.responses_function_call_item(
+        "r1", 0, {"id": "call_9", "function": {"name": "get_weather", "arguments": '{"city":"SF"}'}}
+    )
+    assert item["type"] == "function_call"
+    assert item["call_id"] == "call_9"
+    assert item["name"] == "get_weather"
+    assert item["arguments"] == '{"city":"SF"}'
+    calls = [{"id": "call_9", "function": {"name": "f", "arguments": "{}"}}]
+    resp = oai.responses_response("r1", "m", "ok", {"prompt_tokens": 1, "completion_tokens": 2},
+                                  tool_calls=calls)
+    assert [o["type"] for o in resp["output"]] == ["message", "function_call"]
+    # Tool-call-only responses omit the empty message item.
+    resp = oai.responses_response("r1", "m", "", {}, tool_calls=calls)
+    assert [o["type"] for o in resp["output"]] == ["function_call"]

@@ -82,3 +82,135 @@ def test_rejects_unknown_version(tmp_path):
     write_gguf(p, version=9)
     with pytest.raises(GgufError):
         parse_gguf(str(p))
+
+
+def write_gguf_with_data(path, metadata, named_arrays):
+    """Write a full GGUF file: header + directory + aligned f32 tensor data.
+    ``named_arrays``: [(name, np.ndarray f32 in logical [out, in] shape)] —
+    stored with ggml's reversed ne convention."""
+    import numpy as np
+
+    align = 32
+    tensors = []
+    blobs = []
+    offset = 0
+    for name, arr in named_arrays:
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        dims = list(reversed(arr.shape))  # ne[0] = contiguous dim
+        tensors.append((name, dims, 0, offset))
+        raw = arr.tobytes()
+        pad = (-len(raw)) % align
+        blobs.append(raw + b"\0" * pad)
+        offset += len(raw) + pad
+    out = b"GGUF" + struct.pack("<IQQ", 3, len(tensors), len(metadata))
+    for key, vtype, raw in metadata:
+        out += _s(key) + struct.pack("<I", vtype) + raw
+    for name, dims, gtype, off in tensors:
+        out += _s(name) + struct.pack("<I", len(dims))
+        for d in dims:
+            out += struct.pack("<Q", d)
+        out += struct.pack("<IQ", gtype, off)
+    out += b"\0" * ((-len(out)) % align)
+    for b in blobs:
+        out += b
+    path.write_bytes(out)
+
+
+def test_load_gguf_checkpoint_roundtrip(tmp_path):
+    """A tiny model's params exported to GGUF load back identically (f32),
+    and config_from_gguf reconstructs the architecture (ref: local_model.rs
+    GGUF resolution + the engines' gguf loading)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.weights import config_from_gguf, load_gguf_checkpoint
+
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    lp = params["layers"]
+
+    arrays = [
+        ("token_embd.weight", np.asarray(params["embed"])),
+        ("output_norm.weight", np.asarray(params["final_norm"])),
+        ("output.weight", np.asarray(params["lm_head"]).T),  # HF [out, in]
+    ]
+    for l in range(cfg.num_layers):
+        arrays += [
+            (f"blk.{l}.attn_norm.weight", np.asarray(lp["attn_norm"][l])),
+            (f"blk.{l}.ffn_norm.weight", np.asarray(lp["mlp_norm"][l])),
+            (f"blk.{l}.attn_q.weight", np.asarray(lp["wq"][l]).T),
+            (f"blk.{l}.attn_k.weight", np.asarray(lp["wk"][l]).T),
+            (f"blk.{l}.attn_v.weight", np.asarray(lp["wv"][l]).T),
+            (f"blk.{l}.attn_output.weight", np.asarray(lp["wo"][l]).T),
+            (f"blk.{l}.ffn_gate.weight", np.asarray(lp["w_gate"][l]).T),
+            (f"blk.{l}.ffn_up.weight", np.asarray(lp["w_up"][l]).T),
+            (f"blk.{l}.ffn_down.weight", np.asarray(lp["w_down"][l]).T),
+        ]
+    meta = [
+        ("general.architecture", 8, _s("llama")),
+        ("general.name", 8, _s("tiny-gguf")),
+        ("llama.context_length", 4, struct.pack("<I", cfg.max_seq_len)),
+        ("llama.block_count", 4, struct.pack("<I", cfg.num_layers)),
+        ("llama.embedding_length", 4, struct.pack("<I", cfg.hidden_size)),
+        ("llama.feed_forward_length", 4, struct.pack("<I", cfg.intermediate_size)),
+        ("llama.attention.head_count", 4, struct.pack("<I", cfg.num_heads)),
+        ("llama.attention.head_count_kv", 4, struct.pack("<I", cfg.num_kv_heads)),
+        ("llama.rope.freq_base", 6, struct.pack("<f", cfg.rope_theta)),
+        ("llama.attention.layer_norm_rms_epsilon", 6, struct.pack("<f", cfg.rms_norm_eps)),
+    ]
+    path = tmp_path / "tiny.gguf"
+    write_gguf_with_data(path, meta, arrays)
+
+    got_cfg = config_from_gguf(str(path))
+    assert got_cfg.hidden_size == cfg.hidden_size
+    assert got_cfg.num_layers == cfg.num_layers
+    assert got_cfg.num_kv_heads == cfg.num_kv_heads
+    assert got_cfg.vocab_size == cfg.vocab_size
+    assert not got_cfg.tie_word_embeddings  # output.weight present
+
+    loaded = load_gguf_checkpoint(str(path), cfg, dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+def test_q8_0_dequant(tmp_path):
+    """q8_0 blocks (f16 scale + 32 int8) dequantize to scale*code."""
+    import numpy as np
+
+    from dynamo_tpu.llm.gguf import load_tensors
+
+    codes = np.arange(-16, 16, dtype=np.int8)
+    scale = np.float16(0.5)
+    raw = scale.tobytes() + codes.tobytes()
+    align = 32
+    out = b"GGUF" + struct.pack("<IQQ", 3, 1, 0)
+    out += _s("t") + struct.pack("<I", 1) + struct.pack("<Q", 32) + struct.pack("<IQ", 8, 0)
+    out += b"\0" * ((-len(out)) % align)
+    out += raw
+    path = tmp_path / "q.gguf"
+    path.write_bytes(out)
+    t = load_tensors(str(path))["t"]
+    np.testing.assert_allclose(t, codes.astype(np.float32) * 0.5)
+
+
+def test_resolve_hf_cache_layout(tmp_path, monkeypatch):
+    """resolve_model follows the HF hub cache layout with refs/main
+    (ref: hub.rs:299 resolution)."""
+    from dynamo_tpu.engine.weights import resolve_model
+
+    repo = tmp_path / "hub" / "models--org--model"
+    snap = repo / "snapshots" / "abc123"
+    snap.mkdir(parents=True)
+    (snap / "model.safetensors").write_bytes(b"x")
+    (repo / "refs").mkdir()
+    (repo / "refs" / "main").write_text("abc123\n")
+    monkeypatch.setenv("HF_HOME", str(tmp_path))
+    assert resolve_model("org/model") == str(snap)
+    assert resolve_model("org/missing") is None
+    # Direct GGUF file path resolves to itself.
+    g = tmp_path / "m.gguf"
+    g.write_bytes(b"GGUF")
+    assert resolve_model(str(g)) == str(g)

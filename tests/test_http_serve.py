@@ -231,3 +231,32 @@ def test_tls_requires_both_paths():
     manager = ModelManager()
     with pytest.raises(ValueError, match="both"):
         HttpService(manager, tls_cert="/tmp/x.pem")
+
+
+async def test_queue_time_metric_exported():
+    """The frontend histograms engine-admission queue time per request
+    (ref: http_queue_guard, http/service/metrics.rs) — the SLA planner's
+    saturation signal."""
+    service, engine = await make_local_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": MODEL,
+                "messages": [{"role": "user", "content": "queue metric probe"}],
+                "max_tokens": 4,
+            }
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                await r.json()
+            async with s.get(f"http://127.0.0.1:{service.port}/metrics") as r:
+                text = await r.text()
+        assert "queue_time_seconds" in text
+        for line in text.splitlines():
+            if line.startswith("dynamo_frontend_queue_time_seconds_count"):
+                assert float(line.split()[-1]) >= 1
+                break
+        else:
+            raise AssertionError("queue_time_seconds histogram count not found")
+    finally:
+        await service.stop()
+        await engine.stop()

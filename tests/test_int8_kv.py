@@ -33,10 +33,10 @@ def test_quantize_roundtrip_stable():
 
 
 def test_config_guards():
-    with pytest.raises(ValueError, match="MLA"):
-        get_config("tiny-mla").replace(kv_cache_dtype="int8")
-    with pytest.raises(ValueError, match="gather"):
-        CFG.replace(kv_cache_dtype="int8", attention_impl="paged_kernel")
+    # MLA int8 latents are supported since r4 (per-token latent-row scale).
+    assert get_config("tiny-mla").replace(kv_cache_dtype="int8").kv_cache_dtype == "int8"
+    with pytest.raises(ValueError, match="attention_impl"):
+        CFG.replace(attention_impl="paged_kernel")  # deleted r4
 
 
 def test_prefill_decode_parity_within_tolerance():
@@ -163,3 +163,36 @@ async def test_engine_e2e_int8():
         assert len(out) == 8
     finally:
         await engine.stop()
+
+
+def test_mla_int8_latent_parity():
+    """MLA latent rows under int8: prefill + decode logits agree with the
+    full-precision cache to quantization tolerance (VERDICT r3 #10)."""
+    from dynamo_tpu.engine.models import mla
+
+    cfg = get_config("tiny-mla")
+    params = mla.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 255, 20), jnp.int32)
+    table = jnp.asarray(np.pad(np.arange(1, 4, dtype=np.int32), (0, 13)))
+
+    def run(kv_dtype):
+        c = cfg.replace(kv_cache_dtype=kv_dtype)
+        cache = KvCacheArrays.create(c, num_blocks=16, dtype=jnp.float32)
+        lg, k, v = mla.prefill(
+            params, c, cache.k, cache.v, jnp.pad(toks, (0, 12)),
+            jnp.int32(20), jnp.int32(0), table,
+        )
+        tables = jnp.asarray(np.pad(np.arange(1, 4, dtype=np.int32), (0, 1)))[None, :]
+        dlg, _, _ = mla.decode(
+            params, c, k, v, jnp.asarray([3], jnp.int32), jnp.asarray([20], jnp.int32),
+            tables, jnp.asarray([True]),
+        )
+        return np.asarray(lg), np.asarray(dlg)
+
+    lg_f, dlg_f = run("auto")
+    lg_q, dlg_q = run("int8")
+    np.testing.assert_allclose(lg_q, lg_f, rtol=0.1, atol=0.15)
+    np.testing.assert_allclose(dlg_q, dlg_f, rtol=0.1, atol=0.15)
+    # And the distributions agree where it matters: same greedy token.
+    assert int(np.argmax(lg_q)) == int(np.argmax(lg_f))
+    assert int(np.argmax(dlg_q)) == int(np.argmax(dlg_f))

@@ -125,8 +125,9 @@ def test_moe_capacity_matches_dense_when_no_drops():
     cfg, lp, x = _mk_moe_inputs(E, K)
     cfg = cfg.replace(moe_capacity_factor=E / K)
     ref = llama._moe_dense(x, lp, cfg)
-    out = llama._moe_capacity(x, lp, cfg)
+    out, dropped = llama._moe_capacity(x, lp, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    assert int(dropped) == 0
 
 
 def test_moe_capacity_drops_overflow_to_residual():
@@ -135,11 +136,14 @@ def test_moe_capacity_drops_overflow_to_residual():
     E, K = 4, 2
     cfg, lp, x = _mk_moe_inputs(E, K, T=16)
     cfg = cfg.replace(moe_capacity_factor=E / (16 * K))  # C = 1
-    out = llama._moe_capacity(x, lp, cfg)
+    out, dropped = llama._moe_capacity(x, lp, cfg)
     assert out.shape == x.shape
     assert np.isfinite(np.asarray(out)).all()
+    # Drop counter reports the overflow: 16 tokens * K=2 wanted, 4 slots kept.
+    assert int(dropped) == 16 * K - 4
     # Strictly fewer kept assignments than the no-drop run ⇒ smaller norm.
-    full = llama._moe_capacity(x, lp, cfg.replace(moe_capacity_factor=E / K))
+    full, d_full = llama._moe_capacity(x, lp, cfg.replace(moe_capacity_factor=E / K))
+    assert int(d_full) == 0
     assert np.linalg.norm(np.asarray(out)) < np.linalg.norm(np.asarray(full))
 
 
@@ -258,9 +262,10 @@ def test_moe_capacity_inactive_lanes_cannot_steal_slots():
     x = jnp.concatenate([dead, live], axis=0)  # live token last
     valid = jnp.zeros((T,), dtype=bool).at[T - 1].set(True)
 
-    out_masked = llama._moe_capacity(x, lp, cfg, valid=valid)
+    out_masked, dropped = llama._moe_capacity(x, lp, cfg, valid=valid)
+    assert int(dropped) == 0  # dead lanes are not live assignments
     # Reference: live token alone (no contention at all).
-    ref = llama._moe_capacity(live, lp, cfg.replace(moe_capacity_factor=E / K))
+    ref, _ = llama._moe_capacity(live, lp, cfg.replace(moe_capacity_factor=E / K))
     np.testing.assert_allclose(np.asarray(out_masked[-1]), np.asarray(ref[0]), rtol=1e-5, atol=1e-5)
     # And the dead lanes contribute nothing.
     np.testing.assert_allclose(np.asarray(out_masked[:-1]), 0.0, atol=1e-6)

@@ -1,111 +1,56 @@
-"""Pallas paged-attention decode kernel vs the XLA gather reference.
-
-Runs the kernel in interpreter mode on the CPU mesh (same code path that
-compiles on TPU — pallas_guide.md: ``interpret=True``).
-"""
+"""Decode-attention two-piece online-softmax math (the decode backend after
+the Pallas paged kernel's r4 deletion — see ModelConfig.attention_impl for
+the measurement record). The pieces and merge must equal dense masked
+attention exactly."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from dynamo_tpu.engine.attention.paged import paged_decode_attention
-from dynamo_tpu.engine.config import get_config
-from dynamo_tpu.engine.models.llama import _attend
+from dynamo_tpu.engine.models.llama import _attend_piece, _merge_pieces
 
 
-def _reference(q, k_cache, v_cache, tables, kv_lens, config):
-    """Gather-based reference: the llama.py decode attention path."""
-    B = q.shape[0]
-    bs = config.block_size
-    ctx = tables.shape[1] * bs
-    k_ctx = k_cache[tables].reshape(B, ctx, config.num_kv_heads, config.head_dim)
-    v_ctx = v_cache[tables].reshape(B, ctx, config.num_kv_heads, config.head_dim)
-    key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    mask = key_pos[None, :] < kv_lens[:, None]
-    return jax.vmap(lambda qb, kb, vb, mb: _attend(qb[None], kb, vb, mb[None], config)[0])(
-        q, k_ctx, v_ctx, mask
+def _dense_reference(qg, k_all, v_all, mask):
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, k_all) * (qg.shape[-1] ** -0.5)
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    return jnp.einsum("bkgs,bskd->bkgd", jax.nn.softmax(s, axis=-1), v_all)
+
+
+def test_two_piece_merge_matches_dense():
+    B, S1, S2, KVH, G, HD = 3, 24, 5, 2, 4, 16
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, KVH, G, HD), jnp.float32)
+    k1 = jax.random.normal(jax.random.fold_in(key, 1), (B, S1, KVH, HD), jnp.float32)
+    v1 = jax.random.normal(jax.random.fold_in(key, 2), (B, S1, KVH, HD), jnp.float32)
+    k2 = jax.random.normal(jax.random.fold_in(key, 3), (B, S2, KVH, HD), jnp.float32)
+    v2 = jax.random.normal(jax.random.fold_in(key, 4), (B, S2, KVH, HD), jnp.float32)
+    m1_mask = jnp.arange(S1)[None, :] < jnp.asarray([24, 9, 0])[:, None]  # full/ragged/empty
+    m2_mask = jnp.ones((B, S2), bool)
+
+    scale = HD**-0.5
+    m1, l1, a1 = _attend_piece(q, k1, v1, m1_mask, scale)
+    m2, l2, a2 = _attend_piece(q, k2, v2, m2_mask, scale)
+    out = _merge_pieces(m1, l1, a1, m2, l2, a2)
+
+    ref = _dense_reference(
+        q, jnp.concatenate([k1, k2], 1), jnp.concatenate([v1, v2], 1),
+        jnp.concatenate([m1_mask, m2_mask], 1),
     )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_paged_decode_matches_gather(seed):
-    cfg = get_config("tiny")
-    key = jax.random.PRNGKey(seed)
-    B, N, W = 4, 32, 8
-    kq, kk, kv, kt, kl = jax.random.split(key, 5)
+def test_empty_piece_drops_out():
+    """A fully-masked piece (m=-inf, l=0) must not perturb the merge."""
+    B, S, KVH, G, HD = 2, 8, 2, 2, 16
+    key = jax.random.PRNGKey(7)
+    q = jax.random.normal(key, (B, KVH, G, HD), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, S, KVH, HD), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, S, KVH, HD), jnp.float32)
+    live = jnp.ones((B, S), bool)
+    dead = jnp.zeros((B, S), bool)
 
-    q = jax.random.normal(kq, (B, cfg.num_heads, cfg.head_dim), dtype=jnp.float32)
-    k_cache = jax.random.normal(kk, (N, cfg.block_size, cfg.num_kv_heads, cfg.head_dim), dtype=jnp.float32)
-    v_cache = jax.random.normal(kv, (N, cfg.block_size, cfg.num_kv_heads, cfg.head_dim), dtype=jnp.float32)
-    tables = jax.random.randint(kt, (B, W), 1, N, dtype=jnp.int32)
-    # Mixed lengths incl. a partial page and an inactive row (len 0).
-    kv_lens = jnp.array([1, cfg.block_size * 2 + 3, cfg.block_size * W, 0], dtype=jnp.int32)
-
-    out = paged_decode_attention(
-        q, k_cache, v_cache, tables, kv_lens, block_size=cfg.block_size, interpret=True
-    )
-    ref = _reference(q, k_cache, v_cache, tables, kv_lens, cfg)
-
-    np.testing.assert_allclose(
-        np.asarray(out[:3]), np.asarray(ref[:3]), rtol=2e-5, atol=2e-5
-    )
-    # Inactive row: kernel returns zeros (never consumed — padded batch slot).
-    np.testing.assert_array_equal(np.asarray(out[3]), np.zeros_like(out[3]))
-
-
-def test_paged_decode_bf16():
-    cfg = get_config("tiny")
-    key = jax.random.PRNGKey(2)
-    B, N, W = 2, 16, 4
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (B, cfg.num_heads, cfg.head_dim), dtype=jnp.bfloat16)
-    k_cache = jax.random.normal(kk, (N, cfg.block_size, cfg.num_kv_heads, cfg.head_dim), dtype=jnp.bfloat16)
-    v_cache = jax.random.normal(kv, (N, cfg.block_size, cfg.num_kv_heads, cfg.head_dim), dtype=jnp.bfloat16)
-    tables = jnp.arange(1, 1 + B * W, dtype=jnp.int32).reshape(B, W)
-    kv_lens = jnp.array([cfg.block_size + 5, 7], dtype=jnp.int32)
-
-    out = paged_decode_attention(
-        q, k_cache, v_cache, tables, kv_lens, block_size=cfg.block_size, interpret=True
-    )
-    ref = _reference(q.astype(jnp.float32), k_cache.astype(jnp.float32), v_cache.astype(jnp.float32), tables, kv_lens, cfg)
-    np.testing.assert_allclose(
-        np.asarray(out).astype(np.float32), np.asarray(ref), rtol=5e-2, atol=5e-2
-    )
-
-
-async def test_engine_e2e_with_paged_kernel():
-    """Full scheduler decode loop with the Pallas kernel (interpret mode on
-    CPU) must produce the same greedy tokens as the gather path."""
-    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
-    from dynamo_tpu.engine.scheduler import SchedulerConfig
-    from dynamo_tpu.runtime.engine import Context
-
-    async def run(impl):
-        args = EngineArgs(
-            model="tiny",
-            model_config=get_config("tiny").replace(attention_impl=impl),
-            dtype="float32",
-            scheduler=SchedulerConfig(
-                num_blocks=64, max_running=4,
-                prefill_buckets=[16, 32], decode_buckets=[1, 2, 4],
-            ),
-        )
-        engine = TpuEngine.build(args)
-        try:
-            out = []
-            async for frame in engine.generate(
-                {"token_ids": list(range(10, 30)),
-                 "sampling_options": {"temperature": 0.0},
-                 "stop_conditions": {"max_tokens": 6}},
-                Context(),
-            ):
-                out.extend(frame["token_ids"])
-            return out
-        finally:
-            await engine.stop()
-
-    gather = await run("gather")
-    kernel = await run("paged_kernel")
-    assert len(gather) == 6
-    assert gather == kernel
+    m1, l1, a1 = _attend_piece(q, k, v, live, HD**-0.5)
+    m2, l2, a2 = _attend_piece(q, k, v, dead, HD**-0.5)
+    merged = _merge_pieces(m1, l1, a1, m2, l2, a2)
+    solo = a1 / jnp.maximum(l1, 1e-30)[..., None]
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(solo), rtol=1e-6, atol=1e-6)

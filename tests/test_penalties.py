@@ -1,0 +1,78 @@
+"""Frequency/presence penalties: batched logit op semantics + end-to-end
+through the scheduler and the engine wire (VERDICT r3 #4; ref:
+protocols/common SamplingOptions, protocols/openai/validate.rs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams, apply_penalties
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+
+
+def test_apply_penalties_semantics():
+    logits = jnp.zeros((2, 8), jnp.float32)
+    # Row 0: token 3 twice, token 5 once. Row 1: no history.
+    hist = jnp.asarray([[3, 3, 5, 0], [0, 0, 0, 0]], jnp.int32)
+    hist_len = jnp.asarray([3, 0], jnp.int32)
+    freq = jnp.asarray([0.5, 0.5], jnp.float32)
+    pres = jnp.asarray([1.0, 1.0], jnp.float32)
+    out = np.asarray(apply_penalties(logits, hist, hist_len, freq, pres))
+    np.testing.assert_allclose(out[0, 3], -0.5 * 2 - 1.0)
+    np.testing.assert_allclose(out[0, 5], -0.5 * 1 - 1.0)
+    np.testing.assert_allclose(out[0, 0], 0.0)  # padding adds nothing to token 0
+    np.testing.assert_allclose(out[1], np.zeros(8))  # empty history: untouched
+
+
+def test_greedy_presence_penalty_no_repeats():
+    """A huge presence penalty makes greedy decoding emit all-distinct
+    tokens; the unpenalized run (tiny random model) repeats."""
+    c = get_config("tiny")
+    params = llama.init_params(c, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def run(pres):
+        sched = Scheduler(c, params, SchedulerConfig(num_blocks=64), dtype=jnp.float32)
+        seq = sched.add_request(
+            "r", [1, 2, 3, 4], SamplingParams(temperature=0.0, presence_penalty=pres),
+            StopConditions(max_tokens=12, ignore_eos=True),
+        )
+        for _ in range(40):
+            sched.step()
+            if seq.state.value == "finished":
+                break
+        return seq.output_ids
+
+    penalized = run(1e6)
+    assert len(penalized) == len(set(penalized)), penalized
+    # Sanity: the penalty actually changed the distribution vs baseline.
+    assert penalized != run(0.0)
+
+
+async def test_engine_wire_accepts_penalties():
+    """sampling_options.{frequency,presence}_penalty reach SamplingParams."""
+    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    eng = TpuEngine.build(EngineArgs(model="tiny", dtype="float32"))
+    req = {
+        "token_ids": [1, 2, 3],
+        "sampling_options": {"temperature": 0.0, "frequency_penalty": 0.7, "presence_penalty": 0.2},
+        "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+    }
+    captured = {}
+    orig_add = eng.scheduler.add_request
+
+    def spy(rid, tokens, sampling, stop, **kw):
+        captured["sampling"] = sampling
+        return orig_add(rid, tokens, sampling, stop, **kw)
+
+    eng.scheduler.add_request = spy
+    toks = []
+    async for frame in eng.generate(req, Context(id="p1")):
+        toks.extend(frame["token_ids"])
+    assert len(toks) == 4
+    assert captured["sampling"].frequency_penalty == 0.7
+    assert captured["sampling"].presence_penalty == 0.2
+    await eng.stop()

@@ -1,0 +1,74 @@
+"""A/B decode attention backends on the real chip.
+
+Usage: python tools/bench_decode_impl.py [model] [ctx]
+Times multi-step-window decode (bench.py methodology: donated cache, real
+host sync) for the gather decode path across batch sizes. (The Pallas
+paged kernel this A/B'd against was deleted in r4 — it lost everywhere;
+see ModelConfig.attention_impl.)
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+
+model = sys.argv[1] if len(sys.argv) > 1 else "llama-3.2-1b"
+ctx_len = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+window = 16
+steps = 128
+
+HBM_GBPS = 856.0  # measured copy roofline on this chip (tools probe)
+
+base = get_config(model).replace(max_seq_len=max(4096, ctx_len + 512))
+params = llama.init_params(base, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+pbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def run(impl, batch):
+    cfg = base.replace(attention_impl=impl)
+    num_blocks = batch * (ctx_len // cfg.block_size + 4) + 8
+    cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
+    needed = (ctx_len + steps + 1 + cfg.block_size - 1) // cfg.block_size
+    w = 4
+    while w < needed:
+        w *= 2
+    tables = jnp.tile(jnp.arange(1, w + 1, dtype=jnp.int32)[None, :], (batch, 1))
+    tables = (tables + jnp.arange(batch, dtype=jnp.int32)[:, None] * (ctx_len // cfg.block_size)) % (num_blocks - 1) + 1
+    active = jnp.ones((batch,), dtype=bool)
+    zf = jnp.zeros((batch,), jnp.float32)
+    zi = jnp.zeros((batch,), jnp.int32)
+    of = jnp.ones((batch,), jnp.float32)
+
+    fn = jax.jit(
+        lambda p, k, v, t, pos, key: llama.decode_multi(
+            p, cfg, k, v, t, pos, tables, active, zf, zi, of, key, window
+        ),
+        donate_argnums=(1, 2),
+    )
+    toks = jnp.zeros((batch,), dtype=jnp.int32)
+    pos = jnp.full((batch,), ctx_len, dtype=jnp.int32)
+    k, v = cache.k, cache.v
+    out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(0))
+    np.asarray(out)  # real sync
+    n_windows = max(1, steps // window)
+    t0 = time.perf_counter()
+    for i in range(n_windows):
+        out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(i))
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / (n_windows * window)
+    kv_bytes = 2 * cfg.num_layers * ctx_len * cfg.num_kv_heads * cfg.head_dim * 2 * batch
+    gbps = (pbytes + kv_bytes) / dt / 1e9
+    print(
+        f"{impl:12s} b{batch:2d}: {dt*1e3:7.3f} ms/step  {batch/dt:7.0f} tok/s/chip  "
+        f"{gbps:5.0f} GB/s ({100*gbps/HBM_GBPS:.1f}% roofline)"
+    )
+
+
+for batch in (8, 16, 32):
+    run("gather", batch)

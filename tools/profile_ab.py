@@ -59,8 +59,6 @@ def main():
         variants[name] = run_once
 
     add_decode_variant("gather", "gather")
-    if cfg.kv_size % 128 == 0 and cfg.block_size % 8 == 0:
-        add_decode_variant("kernel", "paged_kernel")
 
     # Weights-only floor (no cache, no attention reads).
     def make_floor():

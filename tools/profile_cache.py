@@ -21,7 +21,6 @@ from jax import lax
 
 from dynamo_tpu.engine.config import get_config
 from dynamo_tpu.engine.models import llama
-from dynamo_tpu.engine.attention.paged import paged_decode_attention
 
 
 def bench_step(step, args, donate_ids, iters=50):
@@ -86,11 +85,7 @@ def main():
                     vc = vc.at[l, tgt_blocks, tgt_offs].set(v)
                 kl = lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
                 vl = lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
-                if attn == "kernel":
-                    a = paged_decode_attention(q, kl, vl, tbl, kv_lens,
-                                               block_size=c.block_size,
-                                               interpret=jax.default_backend() != "tpu")
-                elif attn == "gather":
+                if attn == "gather":
                     ctxlen = tbl.shape[1] * c.block_size
                     k_ctx = kl[tbl].reshape(B, ctxlen, c.num_kv_heads, c.head_dim)
                     v_ctx = vl[tbl].reshape(B, ctxlen, c.num_kv_heads, c.head_dim)
@@ -145,11 +140,7 @@ def main():
                 vl = vs[l].at[tgt_blocks, tgt_offs].set(v)
                 ks_out.append(kl)
                 vs_out.append(vl)
-                if attn == "kernel":
-                    a = paged_decode_attention(q, kl, vl, tbl, kv_lens,
-                                               block_size=c.block_size,
-                                               interpret=jax.default_backend() != "tpu")
-                else:
+                if attn == "gather":
                     ctxlen = tbl.shape[1] * c.block_size
                     k_ctx = kl[tbl].reshape(B, ctxlen, c.num_kv_heads, c.head_dim)
                     v_ctx = vl[tbl].reshape(B, ctxlen, c.num_kv_heads, c.head_dim)

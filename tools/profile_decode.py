@@ -64,10 +64,8 @@ def main():
     results = {}
 
     # --- full decode (gather) ---
-    for name, impl in (("gather", "gather"), ("kernel", "paged_kernel")):
+    for name, impl in (("gather", "gather"),):
         c = cfg.replace(attention_impl=impl)
-        if impl == "paged_kernel" and (c.kv_size % 128 or c.block_size % 8):
-            continue
         step = jax.jit(
             lambda p, k, v, t, po: llama.decode(p, c, k, v, t, po, tables, active),
             donate_argnums=(1, 2),
