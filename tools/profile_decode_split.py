@@ -1,0 +1,77 @@
+"""Decompose decode-step time: per-layer cost vs per-step frame overhead.
+
+Runs decode_multi at several layer counts (same shapes otherwise); the slope
+is the true per-layer cost (weights + KV + attention for one layer), the
+intercept is the step frame (embed lookup, final norm, lm_head, sampling,
+window bookkeeping). Compares the slope against the HBM floor for one
+layer's bytes to see how far the layer body is from bandwidth-bound.
+
+Usage: python tools/profile_decode_split.py [batch] [ctx]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+
+batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+ctx_len = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+window, steps = 16, 128
+HBM = 856.0
+
+base = get_config("llama-3.2-1b").replace(max_seq_len=4096)
+
+
+def measure(num_layers):
+    cfg = base.replace(num_layers=num_layers)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    pbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    num_blocks = batch * (ctx_len // cfg.block_size + 4) + 8
+    cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
+    needed = (ctx_len + steps + 1 + cfg.block_size - 1) // cfg.block_size
+    w = (needed + 15) // 16 * 16
+    tables = jnp.tile(jnp.arange(1, w + 1, dtype=jnp.int32)[None, :], (batch, 1))
+    tables = (tables + jnp.arange(batch, dtype=jnp.int32)[:, None] * (ctx_len // cfg.block_size)) % (num_blocks - 1) + 1
+    active = jnp.ones((batch,), dtype=bool)
+    zf, zi, of = jnp.zeros((batch,), jnp.float32), jnp.zeros((batch,), jnp.int32), jnp.ones((batch,), jnp.float32)
+    fn = jax.jit(
+        lambda p, k, v, t, pos, key: llama.decode_multi(
+            p, cfg, k, v, t, pos, tables, active, zf, zi, of, key, window
+        ),
+        donate_argnums=(1, 2),
+    )
+    toks = jnp.zeros((batch,), jnp.int32)
+    pos = jnp.full((batch,), ctx_len, jnp.int32)
+    k, v = cache.k, cache.v
+    out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(0))
+    np.asarray(out)
+    nw = max(1, steps // window)
+    t0 = time.perf_counter()
+    for i in range(nw):
+        out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(i))
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / (nw * window)
+    return dt, pbytes
+
+
+points = []
+for L in (2, 4, 8, 16):
+    dt, pbytes = measure(L)
+    print(f"L={L:3d}: {dt*1e3:7.3f} ms/step (params {pbytes/1e9:.2f} GB)", flush=True)
+    points.append((L, dt))
+
+(l1, t1), (l2, t2) = points[0], points[-1]
+slope = (t2 - t1) / (l2 - l1)
+intercept = t1 - slope * l1
+kv_layer = 2 * ctx_len * 512 * 2 * batch
+w_layer = (2048 * (2048 + 512 * 2 + 2048) + 3 * 2048 * 8192) * 2  # qkvo + mlp bf16
+floor = (kv_layer + w_layer) / HBM / 1e9
+embed_bytes = 128256 * 2048 * 2
+print(f"\nper-layer: {slope*1e3:.3f} ms (HBM floor {floor*1e3:.3f} ms -> {100*floor/slope:.0f}% eff)")
+print(f"step frame: {intercept*1e3:.3f} ms (lm_head read floor {embed_bytes/HBM/1e9*1e3:.3f} ms)")
