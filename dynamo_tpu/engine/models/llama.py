@@ -524,11 +524,6 @@ def decode_multi(
     moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
     return_logits: bool = False,  # static: also return per-step logits [steps, B, V]
 ) -> Tuple[jax.Array, ...]:
-    if moe_stats and return_logits:
-        raise NotImplementedError(
-            "decode_multi: moe_stats and return_logits cannot be combined yet "
-            "(the return tuples would be ambiguous to existing unpackers)"
-        )
     """``num_steps`` autoregressive decode steps + on-device sampling in ONE
     compiled dispatch. Returns (tokens_out [num_steps, B], k_cache, v_cache).
 
@@ -548,6 +543,11 @@ def decode_multi(
     scale on v5e, dominating the step); the window carry is KV-row-sized, so
     the per-step write cost is proportional to tokens produced, not cache
     size."""
+    if moe_stats and return_logits:
+        raise NotImplementedError(
+            "decode_multi: moe_stats and return_logits cannot be combined yet "
+            "(the return tuples would be ambiguous to existing unpackers)"
+        )
     from dynamo_tpu.engine.sampling import sample_batch
 
     c = config

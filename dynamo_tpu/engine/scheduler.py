@@ -279,6 +279,7 @@ class Scheduler:
         )
         self.moe_dropped_total = 0
         self.moe_assignments_total = 0
+        self._pending_aux: list = []
         # llama-only kwargs (MLA's forward has its own signature).
         stats_kw = {"moe_stats": True} if self._moe_stats else {}
         if self._use_flash_prefill:
@@ -452,6 +453,7 @@ class Scheduler:
 
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
+        self._drain_aux()
         return ForwardPassMetrics(
             num_running=len(self.running),
             num_waiting=len(self.waiting),
@@ -689,21 +691,42 @@ class Scheduler:
         for bucket in self.sc.prefill_buckets:
             if bucket > self.sc.max_prefill_chunk:
                 continue
-            width = 16
-            while width * bs < bucket + 1:
-                width *= 2
-            width = min(width, self.max_blocks_per_seq)
-            # Both has_prefix variants: fresh prefills AND chunked/prefix-hit
-            # continuations must not compile mid-traffic. (On the XLA path
-            # hp is a traced no-op arg, so the second call is a cache hit.)
-            for hp in (False, True):
-                _, self.cache.k, self.cache.v = self._consume_aux(
-                    self._prefill_jit(
-                        self.params, self.cache.k, self.cache.v,
-                        jnp.zeros((bucket,), jnp.int32), jnp.int32(1), jnp.int32(0),
-                        jnp.zeros((width,), jnp.int32), hp,
+            min_w = 16
+            while min_w * bs < bucket + 1:
+                min_w *= 2
+            # Serving's _prefill_table buckets by the sequence's TOTAL block
+            # count, not the chunk: a long prompt prefilled in small chunks
+            # uses a wide table from chunk 0, and prefix-hit continuations
+            # inherit the full-prompt width. Warm every pow2 width from the
+            # chunk minimum up to the ctx budget so neither compiles
+            # mid-traffic.
+            p_widths = []
+            w = min_w
+            while True:
+                p_widths.append(min(w, self.max_blocks_per_seq))
+                if w >= max_w or w >= self.max_blocks_per_seq:
+                    break
+                w *= 2
+            for width in sorted(set(p_widths)):
+                # Both has_prefix variants: fresh prefills AND chunked/
+                # prefix-hit continuations. (On the XLA path hp is a traced
+                # no-op arg, so the second call is a cache hit.)
+                for hp in (False, True):
+                    _, self.cache.k, self.cache.v = self._consume_aux(
+                        self._prefill_jit(
+                            self.params, self.cache.k, self.cache.v,
+                            jnp.zeros((bucket,), jnp.int32), jnp.int32(1), jnp.int32(0),
+                            jnp.zeros((width,), jnp.int32), hp,
+                        )
                     )
-                )
+                    count += 1
+                if self.draft_params is not None:
+                    _, self.draft_cache.k, self.draft_cache.v = self._d_prefill_jit(
+                        self.draft_params, self.draft_cache.k, self.draft_cache.v,
+                        jnp.zeros((bucket,), jnp.int32), jnp.int32(1), jnp.int32(0),
+                        jnp.zeros((width,), jnp.int32),
+                    )
+                    count += 1
             self._sample_jit(
                 jnp.zeros((1, self.mc.vocab_size), jnp.float32),
                 jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
@@ -1151,14 +1174,25 @@ class Scheduler:
         return jnp.asarray(table)
 
     def _consume_aux(self, res):
-        """Strip + accumulate the moe-stats aux (when enabled) from a jitted
-        step's result tuple."""
+        """Strip the moe-stats aux (when enabled) from a jitted step's result
+        tuple. The aux scalars stay on device — forcing them here would add a
+        host sync per step on a path that otherwise syncs once; metrics()
+        drains them in a batch."""
         if not self._moe_stats:
             return res
         *main, aux = res
-        self.moe_dropped_total += int(np.asarray(aux["moe_dropped"]))
-        self.moe_assignments_total += int(np.asarray(aux["moe_assignments"]))
+        self._pending_aux.append((aux["moe_dropped"], aux["moe_assignments"]))
+        if len(self._pending_aux) >= 256:
+            self._drain_aux()
         return tuple(main)
+
+    def _drain_aux(self) -> None:
+        if not self._pending_aux:
+            return
+        pend, self._pending_aux = self._pending_aux, []
+        vals = jax.device_get(pend)  # one transfer for the whole batch
+        self.moe_dropped_total += int(sum(int(d) for d, _ in vals))
+        self.moe_assignments_total += int(sum(int(a) for _, a in vals))
 
     def _prefill_mm_jit(self):
         """Lazy jit of the multimodal prefill variant (feature injection)."""

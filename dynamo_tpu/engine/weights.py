@@ -109,6 +109,26 @@ def config_from_gguf(path: str) -> ModelConfig:
         toks = meta.tokens
         vocab = len(toks) if toks else 0
     has_head = any(t.name == "output.weight" for t in meta.tensors)
+    # head_dim: GGUF carries attention.key_length when it differs from
+    # hidden/heads (e.g. some Gemma/Qwen exports); trust it over the ratio.
+    key_len = meta.arch_field("attention.key_length")
+    if key_len:
+        head_dim = int(key_len)
+    else:
+        if heads and hidden % heads != 0:
+            raise ValueError(
+                f"GGUF {path}: embedding_length {hidden} not divisible by "
+                f"head_count {heads} and no attention.key_length present"
+            )
+        head_dim = hidden // max(heads, 1)
+    scaling_type = meta.arch_field("rope.scaling.type")
+    scaling_factor = float(meta.arch_field("rope.scaling.factor") or 1.0)
+    if scaling_type and str(scaling_type) != "none" and scaling_factor != 1.0:
+        raise ValueError(
+            f"GGUF {path}: rope.scaling.type={scaling_type!r} factor="
+            f"{scaling_factor} is not applied by this engine — refusing to "
+            "load with silently-wrong RoPE"
+        )
     return ModelConfig(
         name=meta.model_name or os.path.basename(path),
         vocab_size=vocab,
@@ -116,7 +136,7 @@ def config_from_gguf(path: str) -> ModelConfig:
         num_layers=int(meta.num_layers or 0),
         num_heads=heads,
         num_kv_heads=int(meta.arch_field("attention.head_count_kv") or heads),
-        head_dim=hidden // max(heads, 1),
+        head_dim=head_dim,
         intermediate_size=int(meta.arch_field("feed_forward_length") or 0),
         rope_theta=float(meta.arch_field("rope.freq_base") or 500000.0),
         rms_norm_eps=float(meta.arch_field("attention.layer_norm_rms_epsilon") or 1e-5),

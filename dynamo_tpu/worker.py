@@ -87,7 +87,7 @@ async def amain(args) -> None:
         from dynamo_tpu.llm.multimodal import EncodeWorkerHandler, LocalVisionEncoder
 
         handler = EncodeWorkerHandler(LocalVisionEncoder(preset=args.vision_model, seed=args.vision_seed))
-        ep = drt.namespace(args.namespace).component("encode").endpoint(args.endpoint)
+        ep = drt.namespace(args.namespace).component(args.component or "encode").endpoint(args.endpoint)
         handle = await ep.serve_endpoint(handler.generate, stats_handler=handler.stats_handler)
         logger.info("encode worker ready: vision=%s instance=%x", args.vision_model, handle.instance.instance_id)
         try:
