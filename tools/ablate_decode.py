@@ -1,0 +1,181 @@
+"""Ablate decode_multi's cost components on the real chip.
+
+Re-assembles the production window-decode step from llama.py's internals
+with switchable pieces, so each component's cost is measured inside the
+same dispatch/amortization structure as production (per-call tunnel
+overhead makes out-of-context microbenchmarks useless on axon backends —
+measured: a single gather+attend dispatch reads as ~1 ms when the full
+16-layer step is 10 ms).
+
+Pieces: embed+qkv/o+mlp (weights), prefix gather+attend, window attend,
+lm_head, sampling, window scatter.
+
+Usage: python tools/ablate_decode.py [batch] [ctx] [width]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.kv_cache import KvCacheArrays
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.models.llama import (
+    _attend_piece,
+    _gather_kv,
+    _merge_pieces,
+    _mlp,
+    _scatter_kv,
+    apply_rope,
+    decode_targets,
+    rms_norm,
+)
+from dynamo_tpu.engine.sampling import sample_batch
+
+batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+ctx_len = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+width = int(sys.argv[3]) if len(sys.argv) > 3 else 80
+window, steps = 16, 256
+
+cfg = get_config("llama-3.2-1b").replace(max_seq_len=4096)
+params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+num_blocks = batch * (ctx_len // cfg.block_size + 4) + 8
+
+
+def ablated_decode_multi(
+    params, c, k_cache, v_cache, tokens, positions, block_tables, active,
+    temps, top_ks, top_ps, rng_key, num_steps,
+    *, do_gather=True, do_window=True, do_lm_head=True, do_sample=True,
+    do_mlp=True, do_scatter=True,
+):
+    B = tokens.shape[0]
+    L, KVH, HD = c.num_layers, c.num_kv_heads, c.head_dim
+    bs = c.block_size
+    _, _, mask0 = decode_targets(positions, block_tables, active, bs)
+    kvh, G, hd = KVH, c.num_heads // KVH, c.head_dim
+    scale = hd**-0.5
+    N = k_cache.shape[1]
+    k_flat = k_cache.reshape(L * N, bs, kvh, hd)
+    v_flat = v_cache.reshape(L * N, bs, kvh, hd)
+    ctx = block_tables.shape[1] * bs
+    w = num_steps
+
+    def layer_body(h, xs, poss, k_win_l, v_win_l, small_mask):
+        lp, l = xs
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, hd)
+        k = (x @ lp["wk"]).reshape(B, 1, kvh, hd)
+        v = (x @ lp["wv"]).reshape(B, 1, kvh, hd)
+        q = apply_rope(q, poss[:, None], c.rope_theta)[:, 0]
+        k = apply_rope(k, poss[:, None], c.rope_theta)[:, 0]
+        v = v[:, 0]
+        qg = q.reshape(B, kvh, G, hd)
+        pieces = []
+        if do_gather:
+            tables_l = block_tables + l * N
+            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            pieces.append(_attend_piece(qg, k_ctx, v_ctx, mask0, scale))
+        if do_window:
+            k_small = jnp.concatenate([jnp.swapaxes(k_win_l, 0, 1), k[:, None]], axis=1)
+            v_small = jnp.concatenate([jnp.swapaxes(v_win_l, 0, 1), v[:, None]], axis=1)
+            pieces.append(_attend_piece(qg, k_small, v_small, small_mask, scale))
+        if len(pieces) == 2:
+            attn = _merge_pieces(*pieces[0], *pieces[1]).astype(h.dtype)
+        elif pieces:
+            m, lw, acc = pieces[0]
+            attn = (acc / jnp.maximum(lw, 1e-30)[..., None]).astype(h.dtype)
+        else:
+            attn = qg
+        h = h + attn.reshape(B, c.q_size) @ lp["wo"]
+        if do_mlp:
+            x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+            h = h + _mlp(x, lp, c, valid=active)
+        return h, (k, v)
+
+    def body(i, state):
+        toks, k_win, v_win, out, key = state
+        poss = positions + i
+        h = params["embed"].at[toks].get(mode="clip")
+        small_mask = jnp.concatenate(
+            [jnp.broadcast_to((jnp.arange(w, dtype=jnp.int32) < i)[None, :], (B, w)),
+             jnp.ones((B, 1), dtype=bool)], axis=1)
+        h, (k_rows, v_rows) = lax.scan(
+            lambda hh, xs: layer_body(
+                hh, xs, poss,
+                k_win[xs[1]], v_win[xs[1]], small_mask),
+            h, (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+        )
+        k_win = k_win.at[:, i].set(k_rows)
+        v_win = v_win.at[:, i].set(v_rows)
+        h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
+        if do_lm_head:
+            head = params.get("lm_head")
+            logits = (h @ (head if head is not None else params["embed"].T)).astype(jnp.float32)
+        else:
+            logits = jnp.zeros((B, 256), jnp.float32).at[:, :128].set(h[:, :128].astype(jnp.float32))
+        key, sub = jax.random.split(key)
+        if do_sample:
+            nxt = sample_batch(logits, temps, top_ks, top_ps, sub).astype(jnp.int32)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = out.at[i].set(nxt)
+        return (nxt, k_win, v_win, out, key)
+
+    wdtype = params["embed"].dtype
+    k_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=wdtype)
+    v_win0 = jnp.zeros((L, num_steps, B, KVH, HD), dtype=wdtype)
+    out0 = jnp.zeros((num_steps, B), dtype=jnp.int32)
+    _, k_win, v_win, out, _ = lax.fori_loop(
+        0, num_steps, body, (tokens, k_win0, v_win0, out0, rng_key))
+    if do_scatter:
+        steps_i = jnp.arange(num_steps, dtype=jnp.int32)
+        slots = jnp.where(active[None, :], positions[None, :] + steps_i[:, None], 0)
+        tgt_blocks = jnp.where(
+            active[None, :], block_tables[jnp.arange(B)[None, :], slots // bs], 0)
+        tgt_offs = slots % bs
+        layer_idx = jnp.broadcast_to(
+            jnp.arange(L, dtype=jnp.int32)[:, None, None], (L, num_steps, B))
+        k_cache = _scatter_kv(k_cache, layer_idx, tgt_blocks[None], tgt_offs[None], k_win)
+        v_cache = _scatter_kv(v_cache, layer_idx, tgt_blocks[None], tgt_offs[None], v_win)
+    return out, k_cache, v_cache
+
+
+def measure(label, **flags):
+    cache = KvCacheArrays.create(cfg, num_blocks=num_blocks, dtype=jnp.bfloat16)
+    tables = jnp.tile(jnp.arange(1, width + 1, dtype=jnp.int32)[None, :], (batch, 1))
+    tables = (tables + jnp.arange(batch, dtype=jnp.int32)[:, None] * (ctx_len // cfg.block_size)) % (num_blocks - 1) + 1
+    active = jnp.ones((batch,), bool)
+    zf = jnp.zeros((batch,), jnp.float32)
+    zi = jnp.zeros((batch,), jnp.int32)
+    of = jnp.ones((batch,), jnp.float32)
+    fn = jax.jit(
+        lambda p, k, v, t, pos, key: ablated_decode_multi(
+            p, cfg, k, v, t, pos, tables, active, zf, zi, of, key, window, **flags),
+        donate_argnums=(1, 2))
+    toks = jnp.zeros((batch,), jnp.int32)
+    pos = jnp.full((batch,), ctx_len, jnp.int32)
+    k, v = cache.k, cache.v
+    out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(0)); np.asarray(out)
+    nw = max(1, steps // window)
+    t0 = time.perf_counter()
+    for i in range(nw):
+        out, k, v = fn(params, k, v, toks, pos, jax.random.PRNGKey(i))
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / (nw * window)
+    print(f"{label:34s}: {dt*1e3:7.3f} ms/step", flush=True)
+    return dt
+
+
+full = measure("full (all pieces)")
+measure("no sampling (argmax)", do_sample=False)
+measure("no lm_head/sampling", do_lm_head=False, do_sample=False)
+measure("no prefix gather", do_gather=False)
+measure("no window piece", do_window=False)
+measure("no mlp", do_mlp=False)
+measure("no final scatter", do_scatter=False)
+measure("weights only (no attn pieces)", do_gather=False, do_window=False)
