@@ -26,8 +26,12 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
-    # Paged KV cache block size in tokens (ref default: 64 in MDC,
-    # vLLM uses 16; TPU likes multiples of 8 for sublane alignment).
+    # Paged KV cache block size in tokens (ref default: 64 in MDC, vLLM
+    # uses 16). Measured on v5e at 1B/b32/ctx1024 with the gather path and
+    # equal gathered bytes: bs=16 7.9 ms/step, bs=64 8.3, bs=256 9.8 — XLA
+    # gathers 16-token rows at full efficiency, so bigger pages only add
+    # fragmentation. Revisit if the Pallas paged kernel (attention_impl=
+    # "paged") becomes the default — it wants ≥128-token pages.
     block_size: int = 16
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
@@ -58,18 +62,18 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
-    # Decode attention implementation. "auto" == "gather": the XLA
-    # width-bucketed gather with two-piece online-softmax merge. A Pallas
-    # paged-DMA decode kernel was built and DELETED in r4 after honest
-    # measurement (tools/bench_decode_impl.py): two designs (per-sequence
-    # grid; flat cross-sequence pipelined DMA with per-row kv-len-bounded
-    # strips) both lost 3-6× to the gather at b8-b32/ctx1024-4k — per-page
-    # 16-64KB DMAs cost ~0.6-2.7 µs serialized on v5e and never overlap,
-    # while XLA's gather sustains 370-560 GB/s; even an extreme ragged batch
-    # (1×4K + 31×256 ctx, 11× fewer real bytes for the kernel) still lost
-    # (0.995 vs 0.740 ms/layer). Crossover needs >27× bucket-to-real-bytes
-    # raggedness — no realistic batch. jax's own tuned ragged_paged_attention
-    # rejects these head_dim=64 shapes outright.
+    # Decode attention implementation for the cached-prefix piece.
+    # "auto" == "gather": XLA width-bucketed gather, two-piece online-
+    # softmax merge, once-per-window hoist (decode_multi). "paged" opts in
+    # to the Pallas paged flash-decode kernel (attention/decode.py) —
+    # correct (interpret-mode parity tests) but NOT auto-selected: on this
+    # tunneled v5e runtime every pallas_call execution carries ms-scale
+    # dispatch overhead (a no-op kernel inside a jitted loop measures
+    # 1.3-5 ms/call; 16 per-layer calls/step is fatal), so the kernel
+    # loses to the gather end-to-end regardless of its memory-traffic win.
+    # The r4 kernel was deleted for a different reason (per-page DMA issue
+    # cost at 16-token pages); both records matter if this is revisited on
+    # a direct-attached TPU.
     attention_impl: str = "auto"
     # Prefill chunk attention: "auto" = Pallas flash kernel on TPU
     # (attention/prefill.py — 40.8 TFLOP/s causal vs ~2 for the two-piece
@@ -86,11 +90,14 @@ class ModelConfig:
     kv_cache_dtype: str = "auto"
 
     def __post_init__(self):
-        if self.attention_impl not in ("auto", "gather"):
+        if self.attention_impl not in ("auto", "gather", "paged"):
             raise ValueError(
-                f"attention_impl must be auto|gather, got {self.attention_impl!r} "
-                "(the Pallas paged decode kernel was removed after losing to the "
-                "gather in every measured regime — see attention_impl docs)"
+                f"attention_impl must be auto|gather|paged, got {self.attention_impl!r}"
+            )
+        if self.attention_impl == "paged" and self.kv_cache_dtype == "int8":
+            raise ValueError(
+                "attention_impl='paged' has no int8-KV path — use 'gather' "
+                "(the only int8 decode backend) or bf16 KV"
             )
         if self.prefill_impl not in ("auto", "flash", "xla"):
             raise ValueError(f"prefill_impl must be auto|flash|xla, got {self.prefill_impl!r}")

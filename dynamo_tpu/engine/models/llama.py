@@ -318,6 +318,36 @@ def _mlp(
     return (out, dropped) if stats else out
 
 
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _use_paged_decode(c: ModelConfig, k_cache) -> bool:
+    """Static choice of the decode prefix-attention backend. The Pallas
+    paged flash kernel (attention/decode.py) is explicit opt-in only —
+    "auto" resolves to the gather: on the current runtime per-pallas-call
+    dispatch overhead (ms-scale, measured with no-op kernels) dwarfs the
+    kernel's memory-traffic win at 16 calls per decode step. See
+    ModelConfig.attention_impl for the full record. No int8 path."""
+    if isinstance(k_cache, QuantKv):
+        return False
+    return c.attention_impl == "paged"
+
+
+def _paged_prefix_partials(c: ModelConfig, q, k_flat, v_flat, tables_l, lengths):
+    """Kernel-backed prefix piece in the ``_attend_piece`` partial layout."""
+    from dynamo_tpu.engine.attention.decode import paged_decode_partials
+
+    return paged_decode_partials(
+        q, k_flat, v_flat, tables_l, lengths,
+        num_kv_heads=c.num_kv_heads, block_size=c.block_size,
+        interpret=not _on_tpu(),
+    )
+
+
 def _attend_piece(qg, kp, vp, maskp, scale):
     """Partial decode attention over one KV piece → (m, l, acc) online-
     softmax state. qg [B,KVH,G,hd]; kp/vp [B,S,KVH,hd]; maskp [B,S].
@@ -598,7 +628,11 @@ def decode_multi(
     N = k_cache.shape[1]
     k_ctx_all = v_ctx_all = None
     hoist_bytes = 2 * L * B * ctx_w * KVH * HD * jnp.dtype(wdtype).itemsize
-    if num_steps > 1 and hoist_bytes <= _hoist_gather_budget():
+    if (
+        num_steps > 1
+        and not _use_paged_decode(c, k_cache)
+        and hoist_bytes <= _hoist_gather_budget()
+    ):
         k_flat = k_cache.reshape(L * N, bs, KVH, HD)
         v_flat = v_cache.reshape(L * N, bs, KVH, HD)
         tables_all = block_tables[None] + (jnp.arange(L, dtype=jnp.int32) * N)[:, None, None]
@@ -713,6 +747,10 @@ def _decode_layer_scan_window(
     )  # [B, w+1]
 
     hoisted = k_ctx_all is not None
+    use_paged = not hoisted and _use_paged_decode(c, k_cache)
+    # Prefix length is fixed for the whole window (mask0 semantics): the
+    # window rows live in the carry, not the cache.
+    win_prefix_lens = jnp.minimum(positions - step, ctx).astype(jnp.int32)
 
     def layer_fn(h, xs):
         if hoisted:
@@ -728,13 +766,18 @@ def _decode_layer_scan_window(
         v = v[:, 0]
         qg = q.reshape(B, kvh, G, hd)
 
-        if not hoisted:
-            tables_l = block_tables + l * N
-            # Piece 1: cached prefix via the width-bucketed gather (two-piece
-            # online-softmax — no concat re-materialization of [B, ctx]).
-            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-        m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask0, scale)
+        if use_paged:
+            m1, l1, acc1 = _paged_prefix_partials(
+                c, q, k_flat, v_flat, block_tables + l * N, win_prefix_lens
+            )
+        else:
+            if not hoisted:
+                tables_l = block_tables + l * N
+                # Piece 1: cached prefix via the width-bucketed gather (two-
+                # piece online-softmax — no concat re-materialization).
+                k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+                v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask0, scale)
         # Piece 2: in-register rows [window ; current] — never round-trip HBM.
         k_small = jnp.concatenate([jnp.swapaxes(kwl, 0, 1), k[:, None]], axis=1)  # [B, w+1, ...]
         v_small = jnp.concatenate([jnp.swapaxes(vwl, 0, 1), v[:, None]], axis=1)
@@ -999,6 +1042,8 @@ def decode_layer_scan(
 
     kvh, G, hd = c.num_kv_heads, c.num_heads // c.num_kv_heads, c.head_dim
     scale = hd**-0.5
+    use_paged = _use_paged_decode(c, k_cache)
+    prefix_lens = jnp.minimum(positions, ctx).astype(jnp.int32)
 
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index within this stack
@@ -1012,11 +1057,15 @@ def decode_layer_scan(
         qg = q.reshape(B, kvh, G, hd)
 
         tables_l = block_tables + l * N
-        # Two online-softmax pieces: cached prefix (width-bucketed gather,
-        # no concat re-materialization) + the current token in-register.
-        k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-        v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-        m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask, scale)
+        # Two online-softmax pieces: cached prefix + current token
+        # in-register. Prefix: Pallas paged flash kernel (pages stream
+        # HBM→VMEM once) or the width-bucketed XLA gather fallback.
+        if use_paged:
+            m1, l1, acc1 = _paged_prefix_partials(c, q, k_flat, v_flat, tables_l, prefix_lens)
+        else:
+            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+            m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask, scale)
         m2, l2, acc2 = _attend_piece(
             qg, k[:, None], v[:, None], jnp.ones((B, 1), dtype=bool), scale
         )

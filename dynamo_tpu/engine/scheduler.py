@@ -49,6 +49,25 @@ def next_bucket(n: int, buckets: List[int]) -> int:
     return buckets[-1]
 
 
+def width_rungs(max_w: int, start: int = 4) -> List[int]:
+    """Block-table width rungs up to and including the bucket of ``max_w``:
+    pow2 and 1.5·pow2 (4, 6, 8, 12, 16, 24, ...)."""
+    rungs: List[int] = []
+    w = start
+    while True:
+        rungs.append(w)
+        if w >= max_w:
+            return rungs
+        nxt = w + w // 2 if w & (w - 1) == 0 else (w // 3) * 4
+        w = nxt
+
+
+def width_bucket(n: int, cap: int) -> int:
+    """Smallest pow2-or-1.5·pow2 rung ≥ n, clamped to ``cap``. bench.py uses
+    the same rule so driver decode numbers reflect production table widths."""
+    return min(width_rungs(max(n, 1))[-1], cap)
+
+
 @dataclass
 class StopConditions:
     max_tokens: int = 256
@@ -153,8 +172,12 @@ class SchedulerConfig:
     # the dominant cost on high-latency links. Tradeoffs: tokens stream out
     # in bursts of N, stop conditions trim after the window (up to N-1
     # wasted steps per finished sequence), and admission waits for the
-    # window (only used when no request is waiting).
-    num_scheduler_steps: int = 1
+    # window (only used when no request is waiting). Default 32: measured
+    # on v5e at 1B (gather + hoisted window), window 16→32 takes b8 from
+    # 7.6→4.9 ms/step and b32 from 11.6→8.0 — the hoisted prefix gather,
+    # dispatch, and frame all amortize across the window; 64 gains nothing
+    # further and doubles the burst.
+    num_scheduler_steps: int = 32
     # ITL protection: while sequences are decoding, cap each prefill chunk so
     # its estimated device time stays under this budget (the prefill token
     # rate is learned online from measured chunks). None ⇒ chunks use
@@ -630,15 +653,15 @@ class Scheduler:
         return max(min(cap, budget_tokens), self.sc.prefill_buckets[0])
 
     def _width_bucket(self, max_used: int) -> int:
-        """Power-of-two block-table widths (was: multiples of 16, which both
-        rounded 5 blocks up to 16 — a 3× oversized gather for short contexts
-        — and produced max_seq/256 executable variants that compiled mid-
-        traffic; measured as the dominant serving-plane cost). Pow2 bounds
-        the variants at log2(max_blocks) so warmup() can precompile them."""
-        w = 4
-        while w < max_used:
-            w *= 2
-        return min(w, self.max_blocks_per_seq)
+        """Block-table width buckets at pow2 AND 1.5·pow2 rungs
+        (4, 6, 8, 12, 16, 24, ...). Pure pow2 pays up to 2× gather padding
+        right past a boundary — at 256-token pages a 1025-token context
+        would gather 2048 tokens; the 1.5 rungs cap the waste at 33% for
+        2·log2(max_blocks) executable variants, still few enough for
+        warmup() to precompile. (History: multiples of 16 produced
+        max_seq/256 variants that compiled mid-traffic — the then-dominant
+        serving-plane cost.)"""
+        return width_bucket(max_used, self.max_blocks_per_seq)
 
     def warmup(self, ctx_tokens: int = 2048) -> int:
         """Precompile the serving-hot executables so traffic never waits on
@@ -651,12 +674,7 @@ class Scheduler:
         contents are untouched. Returns the number of executables warmed."""
         bs = self.mc.block_size
         max_w = self._width_bucket((ctx_tokens + bs - 1) // bs)
-        widths = [max_w]  # always include the top (possibly clamped) width
-        w = 4
-        while w < max_w:
-            widths.append(w)
-            w *= 2
-        widths = sorted(set(widths))
+        widths = sorted(set(min(r, self.max_blocks_per_seq) for r in width_rungs(max_w)))
         count = 0
         key = jax.random.PRNGKey(0)
         for bucket in self.sc.decode_buckets:
@@ -688,26 +706,27 @@ class Scheduler:
                 jnp.ones((bucket,), jnp.float32), key, None,
             )
             count += 1
+        prev_bucket = 0
         for bucket in self.sc.prefill_buckets:
             if bucket > self.sc.max_prefill_chunk:
                 continue
-            min_w = 16
-            while min_w * bs < bucket + 1:
-                min_w *= 2
+            # Smallest table width serving can pair with this chunk bucket:
+            # the shortest prompt that maps here (prev_bucket+1 tokens),
+            # bucketed by _prefill_table's rung rule (16 floor).
+            min_w = max(16, width_bucket((prev_bucket + 1 + bs - 1) // bs, self.max_blocks_per_seq))
+            prev_bucket = bucket
             # Serving's _prefill_table buckets by the sequence's TOTAL block
             # count, not the chunk: a long prompt prefilled in small chunks
             # uses a wide table from chunk 0, and prefix-hit continuations
-            # inherit the full-prompt width. Warm every pow2 width from the
-            # chunk minimum up to the ctx budget so neither compiles
+            # inherit the full-prompt width. Warm every rung width from the
+            # bucket's minimum up to the ctx budget so neither compiles
             # mid-traffic.
-            p_widths = []
-            w = min_w
-            while True:
-                p_widths.append(min(w, self.max_blocks_per_seq))
-                if w >= max_w or w >= self.max_blocks_per_seq:
-                    break
-                w *= 2
-            for width in sorted(set(p_widths)):
+            p_widths = sorted(set(
+                min(r, self.max_blocks_per_seq)
+                for r in width_rungs(max(max_w, min_w))
+                if r >= min_w or r >= self.max_blocks_per_seq
+            ))
+            for width in p_widths:
                 # Both has_prefix variants: fresh prefills AND chunked/
                 # prefix-hit continuations. (On the XLA path hp is a traced
                 # no-op arg, so the second call is a cache hit.)
@@ -1218,12 +1237,10 @@ class Scheduler:
         sequence's blocks — NOT padded to max_blocks_per_seq. The prefill
         prefix gather/mask is O(width·block_size), so a 2K prompt must not
         pay for a 128K max_seq_len (measured: the dominant prefill cost at
-        1B on v5e before this). Power-of-two widths bound the executable
-        count at log2(max_blocks) variants per prefill bucket."""
-        w = 16
-        while w < len(seq.block_ids):
-            w *= 2
-        w = min(w, self.max_blocks_per_seq)
+        1B on v5e before this). Rung widths (see width_rungs) bound the
+        executable count at 2·log2(max_blocks) variants per prefill
+        bucket."""
+        w = max(16, width_bucket(len(seq.block_ids), self.max_blocks_per_seq))
         table = np.zeros((w,), dtype=np.int32)
         table[: len(seq.block_ids)] = seq.block_ids
         return jnp.asarray(table)
