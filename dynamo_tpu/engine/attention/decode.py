@@ -72,7 +72,6 @@ def _paged_kernel(
     acc_ref,  # VMEM [1, KVG, KVHD] f32 out
     *,
     block_size: int,
-    width: int,
     scale: float,
 ):
     b, w = pl.program_id(0), pl.program_id(1)
@@ -172,7 +171,7 @@ def paged_decode_partials(
     )
     m, l, acc = pl.pallas_call(
         functools.partial(
-            _paged_kernel, block_size=block_size, width=W, scale=HD**-0.5
+            _paged_kernel, block_size=block_size, scale=HD**-0.5
         ),
         out_shape=(
             jax.ShapeDtypeStruct((B, KVG, 1), jnp.float32),

@@ -341,13 +341,24 @@ class Scheduler:
         self.spec_stats = None
         self._supports_multi_step = hasattr(model, "decode_multi")
         if self._supports_multi_step:
-            self._decode_multi_jit = jax.jit(
-                lambda p, k, v, t, pos, bt, act, te, tk, tp, key: model.decode_multi(
-                    p, self.mc, k, v, t, pos, bt, act, te, tk, tp, key,
-                    self.sc.num_scheduler_steps, **stats_kw,
-                ),
-                donate_argnums=(1, 2),
+            # One executable per window rung: short requests must not pay a
+            # full num_scheduler_steps window (a 16-token request under a
+            # 32-step window wastes half the dispatch). _decode_multi picks
+            # the smallest rung covering the batch's remaining budget.
+            def mk_multi(steps: int):
+                return jax.jit(
+                    lambda p, k, v, t, pos, bt, act, te, tk, tp, key: model.decode_multi(
+                        p, self.mc, k, v, t, pos, bt, act, te, tk, tp, key,
+                        steps, **stats_kw,
+                    ),
+                    donate_argnums=(1, 2),
+                )
+
+            self._window_rungs = sorted(
+                {w for w in (8, 16, self.sc.num_scheduler_steps) if w <= self.sc.num_scheduler_steps}
             )
+            self._decode_multi_jits = {w: mk_multi(w) for w in self._window_rungs}
+            self._decode_multi_jit = self._decode_multi_jits[self._window_rungs[-1]]
 
     def attach_draft(self, draft_config: ModelConfig, draft_params, *, gamma: int = 4) -> None:
         """Enable batched speculative decoding: the draft model proposes γ
@@ -693,13 +704,14 @@ class Scheduler:
                 )
                 count += 1
                 if self.sc.num_scheduler_steps > 1 and self._supports_multi_step:
-                    _, self.cache.k, self.cache.v = self._consume_aux(
-                        self._decode_multi_jit(
-                            self.params, self.cache.k, self.cache.v, toks, pos, tables,
-                            active, temps, tks, tps, key,
+                    for mjit in self._decode_multi_jits.values():
+                        _, self.cache.k, self.cache.v = self._consume_aux(
+                            mjit(
+                                self.params, self.cache.k, self.cache.v, toks, pos, tables,
+                                active, temps, tks, tps, key,
+                            )
                         )
-                    )
-                    count += 1
+                        count += 1
             self._sample_jit(
                 jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
                 jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
@@ -918,7 +930,13 @@ class Scheduler:
         """Multi-step decode window: N steps in one dispatch, one host sync.
         Returns False (caller falls back to single-step) when KV blocks for
         the whole window can't be reserved."""
-        steps = self.sc.num_scheduler_steps
+        # Smallest window rung covering the batch's remaining token budget —
+        # a request needing 5 more tokens dispatches an 8-step window, not
+        # the full num_scheduler_steps.
+        rem = max(
+            max(1, seq.stop.max_tokens - len(seq.output_ids)) for seq in batch
+        )
+        steps = next((w for w in self._window_rungs if w >= rem), self._window_rungs[-1])
         bs = self.mc.block_size
         # Reserve blocks for the whole window up front (+1 for the next
         # iteration's write slot, matching _ensure_block_capacity).
@@ -956,7 +974,7 @@ class Scheduler:
 
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
-        res = self._decode_multi_jit(
+        res = self._decode_multi_jits[steps](
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
