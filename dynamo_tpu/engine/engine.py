@@ -176,6 +176,11 @@ class TpuEngine:
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        kvbm = getattr(self.scheduler, "kvbm", None)
+        if kvbm is not None:
+            # Queued offload snapshots must reach the host/disk tiers —
+            # a persistent G3 dir is supposed to survive restarts.
+            await asyncio.to_thread(kvbm.flush_pending)
 
     async def _loop(self) -> None:
         try:

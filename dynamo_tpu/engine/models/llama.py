@@ -813,11 +813,15 @@ def chunk_decode(
     block_tables: jax.Array,  # [B, W]
     all_logits: bool = False,  # static: return logits [B, S, V] instead of argmax
     moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+    last_logits: bool = False,  # static: return only each row's last-valid logits [B, V]
 ) -> Tuple[jax.Array, ...]:
     """Batched multi-token decode: each row consumes up to S tokens in ONE
     pass and yields the greedy next-token prediction after every consumed
     position → (argmax tokens [B, S] i32, k_cache, v_cache) — or the full
-    per-position logits with ``all_logits=True``.
+    per-position logits with ``all_logits=True``, or only the last valid
+    position's logits per row with ``last_logits=True`` (the batched-
+    admission prefill path: one dispatch prefills a WAVE of short prompts
+    and feeds the sampler directly).
 
     This is the engine primitive behind batched speculative decoding
     (spec_decode.py; ref surfaces SpecDecodeStats, _core.pyi:354-427): the
@@ -930,6 +934,17 @@ def chunk_decode(
 
     h = rms_norm(h, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
+    if last_logits:
+        # Batched-admission prefill: only each row's LAST valid position
+        # feeds sampling, so the lm_head runs on [B, D] picked rows, not
+        # [B, S, D] — and the returned logits are sampler-sized ([B, V],
+        # not a [B, S, V] buffer that would be GBs at real vocab sizes).
+        last = jnp.maximum(valid - 1, 0)  # [B]
+        h_last = jnp.take_along_axis(h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        lg = (h_last @ (head if head is not None else params["embed"].T)).astype(jnp.float32)
+        if moe_stats:
+            return lg, k_new, v_new, chunk_aux
+        return lg, k_new, v_new
     logits = h @ (head if head is not None else params["embed"].T)  # [B, S, V]
     if all_logits:
         # Sampled speculative verification needs the full target

@@ -178,6 +178,14 @@ class SchedulerConfig:
     # dispatch, and frame all amortize across the window; 64 gains nothing
     # further and doubles the burst.
     num_scheduler_steps: int = 32
+    # While requests wait for admission, cap decode windows at this rung
+    # (None = keep full windows). Full windows maximize throughput on
+    # dispatch-latency-heavy links — each window pays one ~100 ms host
+    # round-trip on tunneled devices, so shrinking windows under load
+    # serialized tokens on the wire (measured: served rate fell 25%). A
+    # latency-sensitive deployment can set 8 to bound admission delay at
+    # ~8 step times.
+    window_waiting_cap: Optional[int] = None
     # ITL protection: while sequences are decoding, cap each prefill chunk so
     # its estimated device time stays under this budget (the prefill token
     # rate is learned online from measured chunks). None ⇒ chunks use
@@ -340,6 +348,9 @@ class Scheduler:
         self.spec_gamma = 0
         self.spec_stats = None
         self._supports_multi_step = hasattr(model, "decode_multi")
+        # Batched admission (chunk_decode waves) — llama-family only.
+        self._supports_chunk_admit = hasattr(model, "chunk_decode")
+        self._admit_jits: Dict = {}
         if self._supports_multi_step:
             # One executable per window rung: short requests must not pay a
             # full num_scheduler_steps window (a 16-token request under a
@@ -527,8 +538,24 @@ class Scheduler:
                 outputs.append((seq, StepOutput(token_id=-1, finished=True, finish_reason=seq.abort_reason)))
 
     def _admit(self, outputs: List[tuple]) -> None:
-        """Admit at most one waiting sequence per iteration (chunked)."""
+        """Admit waiting sequences: a batched WAVE when several short
+        prompts wait (one dispatch + one readback for all of them — on
+        dispatch-latency-heavy links per-request prefills serialized
+        admission at one ~100 ms round-trip each), else one chunked
+        prefill."""
         if not self.waiting or len(self.running) >= self.sc.max_running:
+            return
+        # FIFO fairness: waves only form when the HEAD of the queue joins
+        # them — otherwise an ineligible head (long prompt, seeded/logprobs
+        # request) would starve behind an endless stream of wave-admitted
+        # shorts. The head must ALSO fit the wave's chunk cap: a long-prompt
+        # head is exactly the starvation case.
+        head = self.waiting[0]
+        if (
+            self._wave_eligible(head)
+            and len(head.prompt) <= self._wave_s_cap()
+            and self._admit_wave(outputs)
+        ):
             return
         seq = self.waiting[0]
         try:
@@ -540,6 +567,165 @@ class Scheduler:
             return
         if done:
             self.waiting.pop(0)
+
+    def _wave_s_cap(self) -> int:
+        """Longest prompt a wave admission will take in one chunk."""
+        return min(self.sc.max_prefill_chunk, self.sc.prefill_buckets[-1])
+
+    def _get_admit_jit(self, key):
+        """Wave-admission executable for (b_bucket, s_bucket, width) —
+        shared by _admit_wave and warmup so both compile the same thing."""
+        if key not in self._admit_jits:
+            from dynamo_tpu.engine.models import get_module
+
+            model = get_module(self.mc)
+            self._admit_jits[key] = jax.jit(
+                lambda p, k, v, t, p0, vl, bt: model.chunk_decode(
+                    p, self.mc, k, v, t, p0, vl, bt, last_logits=True,
+                    **({"moe_stats": True} if self._moe_stats else {}),
+                ),
+                donate_argnums=(1, 2),
+            )
+        return self._admit_jits[key]
+
+    def _wave_eligible(self, seq: Sequence) -> bool:
+        s = seq.sampling
+        return (
+            seq.state == SeqState.WAITING
+            and seq.prefilled is None
+            and seq.resume_tokens is None
+            and seq.mm_features is None
+            and not s.logprobs
+            and not s.logits_processors
+            and not (s.seed is not None and s.temperature > 0)
+        )
+
+    def _admit_wave(self, outputs: List[tuple]) -> bool:
+        """Prefill a wave of short waiting prompts in ONE ``chunk_decode``
+        dispatch: KV for every row's whole prompt is written batched, the
+        last-valid logits feed the on-device sampler, and the host reads
+        back one [B] token array. Returns True when a wave was admitted.
+
+        Falls through to the single-sequence path for prompts longer than
+        one chunk, non-llama architectures, draft-attached engines (the
+        draft catch-up is per-sequence), and requests needing per-token
+        logprobs/processors/seeded sampling."""
+        if not self._supports_chunk_admit or self.draft_params is not None:
+            return False
+        if self.sc.itl_budget_ms and self.running:
+            # A wave dispatches B×S prompt tokens in one device call —
+            # incompatible with an ITL budget while decodes run; the
+            # single-prefill path enforces the budgeted chunk size.
+            return False
+        s_cap = self._wave_s_cap()
+        room = self.sc.max_running - len(self.running)
+        wave: List[Sequence] = []
+        for seq in self.waiting:
+            if len(wave) >= min(room, self.sc.decode_buckets[-1]):
+                break
+            if not self._wave_eligible(seq):
+                continue
+            if len(seq.prompt) > s_cap:
+                continue
+            wave.append(seq)
+        if len(wave) < 2:
+            return False
+
+        # First touch per seq: prefix match + all-or-nothing allocation
+        # (shared with _prefill_one; a seq that can't allocate ends the wave).
+        admitted: List[Sequence] = []
+        for seq in wave:
+            try:
+                self._first_touch(seq, seq.prompt, len(seq.prompt) + 1)
+            except OutOfBlocksError:
+                break
+            admitted.append(seq)
+        if len(admitted) < 2:
+            # 0 or 1 allocated: hand everything back to the single-seq path
+            # untouched (it re-runs first-touch matching, so blocks/refs
+            # acquired here must be returned first).
+            for seq in admitted:
+                self.allocator.release(seq.block_ids)
+                seq.block_ids = []
+                seq.num_cached_blocks = 0
+                seq.num_computed = 0
+                seq.state = SeqState.WAITING
+            return False
+
+        s_max = max(len(seq.prompt) - seq.num_computed for seq in admitted)
+        s_bucket = next_bucket(s_max, self.sc.prefill_buckets)
+        b_bucket = next_bucket(len(admitted), self.sc.decode_buckets)
+        width = self._width_bucket(max(len(seq.block_ids) for seq in admitted))
+
+        tokens = np.zeros((b_bucket, s_bucket), dtype=np.int32)
+        pos0 = np.zeros((b_bucket,), dtype=np.int32)
+        valid = np.zeros((b_bucket,), dtype=np.int32)
+        tables = np.zeros((b_bucket, width), dtype=np.int32)
+        temps = np.zeros((b_bucket,), dtype=np.float32)
+        top_ks = np.zeros((b_bucket,), dtype=np.int32)
+        top_ps = np.ones((b_bucket,), dtype=np.float32)
+        for i, seq in enumerate(admitted):
+            chunk = seq.prompt[seq.num_computed:]
+            tokens[i, : len(chunk)] = chunk
+            pos0[i] = seq.num_computed
+            valid[i] = len(chunk)
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            temps[i] = seq.sampling.temperature
+            top_ks[i] = seq.sampling.top_k
+            top_ps[i] = seq.sampling.top_p
+
+        res = self._get_admit_jit((b_bucket, s_bucket, width))(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(pos0), jnp.asarray(valid), jnp.asarray(tables),
+        )
+        lg, self.cache.k, self.cache.v = self._consume_aux(res)
+        self._step_counter += 1
+        skey = jax.random.fold_in(self._rng, self._step_counter)
+        sampled = np.asarray(
+            self._sample_jit(
+                lg, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), skey, None
+            )
+        )  # the wave's ONE host sync
+
+        for i, seq in enumerate(admitted):
+            self.waiting.remove(seq)
+            seq.num_computed = len(seq.prompt)
+            seq.first_token_ts = time.monotonic()
+            seq.state = SeqState.RUNNING
+            self.running.append(seq)
+            self._register_full_blocks(seq)
+            self._append_token(seq, int(sampled[i]), outputs)
+        return True
+
+    def _first_touch(self, seq: Sequence, pf_tokens: List[int], total_tokens: int) -> None:
+        """First admission: prefix-cache match + full block allocation,
+        all-or-nothing — a partial failure re-runs next step, so any
+        acquired refs/blocks are returned before OutOfBlocksError
+        propagates. Shared by single prefills and wave admission."""
+        bs = self.mc.block_size
+        try:
+            if self.sc.enable_prefix_caching and seq.mm_features is None:
+                seq.block_hashes = extend_block_hashes([], pf_tokens, bs)
+                matched = self._match_prefix_tiers(seq)
+                # Keep at least one token to prefill so we always produce logits.
+                if matched and len(matched) * bs >= len(pf_tokens):
+                    self.allocator.release([matched[-1]])
+                    matched = matched[:-1]
+                seq.block_ids = list(matched)
+                seq.num_cached_blocks = len(matched)
+                seq.num_computed = len(matched) * bs
+            needed = (total_tokens + bs - 1) // bs - len(seq.block_ids)
+            if needed > 0:
+                seq.block_ids.extend(self.allocator.allocate(needed))
+        except OutOfBlocksError:
+            self.allocator.release(seq.block_ids)
+            seq.block_ids = []
+            seq.num_cached_blocks = 0
+            seq.num_computed = 0
+            raise
+        seq.state = SeqState.PREFILL
+        if seq.admitted_ts is None:
+            seq.admitted_ts = time.monotonic()
 
     def _prefill_one(self, seq: Sequence, outputs: List[tuple]) -> bool:
         """Run one prefill chunk for ``seq``. Returns True when the prompt is
@@ -556,33 +742,8 @@ class Scheduler:
         resuming = seq.resume_tokens is not None
         pf_tokens = seq.resume_tokens if resuming else seq.prompt
         if seq.state == SeqState.WAITING:
-            # First touch: prefix-cache match + full block allocation. Must be
-            # all-or-nothing: a partial failure here re-runs next step, so any
-            # acquired refs/blocks must be returned before backing off.
-            try:
-                if self.sc.enable_prefix_caching and seq.mm_features is None:
-                    seq.block_hashes = extend_block_hashes([], pf_tokens, bs)
-                    matched = self._match_prefix_tiers(seq)
-                    # Keep at least one token to prefill so we always produce logits.
-                    if matched and len(matched) * bs >= len(pf_tokens):
-                        self.allocator.release([matched[-1]])
-                        matched = matched[:-1]
-                    seq.block_ids = list(matched)
-                    seq.num_cached_blocks = len(matched)
-                    seq.num_computed = len(matched) * bs
-                total_tokens = (seq.total_len if resuming else len(seq.prompt)) + 1
-                needed = (total_tokens + bs - 1) // bs - len(seq.block_ids)
-                if needed > 0:
-                    seq.block_ids.extend(self.allocator.allocate(needed))
-            except OutOfBlocksError:
-                self.allocator.release(seq.block_ids)
-                seq.block_ids = []
-                seq.num_cached_blocks = 0
-                seq.num_computed = 0
-                raise
-            seq.state = SeqState.PREFILL
-            if seq.admitted_ts is None:
-                seq.admitted_ts = time.monotonic()
+            total_tokens = (seq.total_len if resuming else len(seq.prompt)) + 1
+            self._first_touch(seq, pf_tokens, total_tokens)
 
         remaining = len(pf_tokens) - seq.num_computed
         chunk = min(remaining, self._chunk_budget())
@@ -764,6 +925,20 @@ class Scheduler:
                 jnp.ones((1,), jnp.float32), key, None,
             )
             count += 1
+            # Wave-admission executable for this chunk bucket at the top
+            # batch bucket and the bucket's minimum table width — the
+            # common wave shape; other (b, s, w) keys still compile
+            # lazily, but the standard burst-arrival case is covered.
+            if self._supports_chunk_admit and self.draft_params is None:
+                b_b = self.sc.decode_buckets[-1]
+                _, self.cache.k, self.cache.v = self._consume_aux(
+                    self._get_admit_jit((b_b, bucket, min_w))(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.zeros((b_b, bucket), jnp.int32), jnp.zeros((b_b,), jnp.int32),
+                        jnp.zeros((b_b,), jnp.int32), jnp.zeros((b_b, min_w), jnp.int32),
+                    )
+                )
+                count += 1
         return count
 
     def _draft_catchup(self, seq: Sequence, tokens: List[int], upto: int) -> None:
@@ -821,7 +996,6 @@ class Scheduler:
         if (
             self.sc.num_scheduler_steps > 1
             and self._supports_multi_step
-            and not self.waiting  # don't delay admissions by a whole window
             and not any(
                 seq.sampling.logits_processors
                 or seq.sampling.logprobs
@@ -932,11 +1106,24 @@ class Scheduler:
         the whole window can't be reserved."""
         # Smallest window rung covering the batch's remaining token budget —
         # a request needing 5 more tokens dispatches an 8-step window, not
-        # the full num_scheduler_steps.
+        # the full num_scheduler_steps. Windows keep running at full size
+        # while requests wait (disabling them under load serialized every
+        # token on the wire — measured 4% of the raw decode rate on a
+        # dispatch-latency-heavy link); deployments that want bounded
+        # admission delay opt in via window_waiting_cap, which caps the
+        # window at the first rung ≥ the configured value.
         rem = max(
             max(1, seq.stop.max_tokens - len(seq.output_ids)) for seq in batch
         )
         steps = next((w for w in self._window_rungs if w >= rem), self._window_rungs[-1])
+        if self.waiting and self.sc.window_waiting_cap:
+            steps = min(
+                steps,
+                next(
+                    (w for w in self._window_rungs if w >= self.sc.window_waiting_cap),
+                    self._window_rungs[-1],
+                ),
+            )
         bs = self.mc.block_size
         # Reserve blocks for the whole window up front (+1 for the next
         # iteration's write slot, matching _ensure_block_capacity).

@@ -93,7 +93,11 @@ def test_itl_budget_bounds_stall_with_running_decode():
     """With an ITL budget, a long prompt admitted next to a running sequence
     prefills in small chunks (multiple scheduler iterations), and the
     running sequence keeps producing tokens between chunks."""
-    sched = make_sched(num_blocks=64, itl_budget_ms=0.001, max_prefill_chunk=64)
+    # Single-step decode: the test's subject is chunked-prefill interleaving
+    # BETWEEN steps; a 32-step window would finish the short request in one
+    # dispatch before the long prompt arrives.
+    sched = make_sched(num_blocks=64, itl_budget_ms=0.001, max_prefill_chunk=64,
+                       num_scheduler_steps=1)
     sched.add_request("short", list(range(1, 17)), SamplingParams(temperature=0.0),
                       StopConditions(max_tokens=30))
     # Let the short one enter decode and learn a prefill rate.
