@@ -32,7 +32,11 @@ import numpy as np
 
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays
 from dynamo_tpu.llm.block_manager.storage import DiskPool, HostPool
-from dynamo_tpu.llm.block_manager.transfer import gather_blocks, scatter_blocks
+from dynamo_tpu.llm.block_manager.transfer import (
+    gather_blocks,
+    gather_blocks_async,
+    scatter_blocks,
+)
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger(__name__)
@@ -87,7 +91,13 @@ class KvBlockManager:
         self.disk = DiskPool(disk_dir, capacity=disk_blocks) if disk_dir and disk_blocks > 0 else None
         self.remote = None  # G4 — attach_remote()
         self.metrics = KvbmMetrics()
-        # Offload-on-eviction: copy out before the device block is reused.
+        # Async offload: eviction snapshots the block ON DEVICE (dispatch-
+        # ordered, no host sync — the old inline gather stalled every
+        # admission on a device→host DMA under memory pressure, ref's
+        # equivalent machinery: block_manager/offload.rs pending queues);
+        # the host transfer happens in one batched drain.
+        self._pending: Dict[int, Tuple] = {}
+        self._pending_cap = 32
         allocator.on_evict = self._offload_block
 
     def attach_remote(self, remote) -> None:
@@ -98,11 +108,38 @@ class KvBlockManager:
 
     # --- offload cascade (G1 → G2 → G3 → G4) --------------------------------
     def _offload_block(self, block_id: int, block_hash: int) -> None:
+        """Eviction hook — runs on the scheduler's admission path, so it
+        must not block: queue a device-side snapshot and return."""
         if self.host is None:
             return
-        if self.host.has(block_hash) or (self.disk is not None and self.disk.has(block_hash)):
+        if (
+            block_hash in self._pending
+            or self.host.has(block_hash)
+            or (self.disk is not None and self.disk.has(block_hash))
+        ):
             return
-        k_np, v_np = gather_blocks(self.cache, block_id)
+        self._pending[block_hash] = gather_blocks_async(self.cache, block_id)
+        if len(self._pending) >= self._pending_cap:
+            self.flush_pending()
+
+    def flush_pending(self) -> int:
+        """Drain queued offload snapshots to the host tier in ONE batched
+        device→host transfer. Called when the queue fills, before tier
+        lookups (pending blocks must be onboardable), and at shutdown."""
+        if not self._pending:
+            return 0
+        items, self._pending = list(self._pending.items()), {}
+        import jax
+
+        flat = jax.device_get([d for _, pair in items for d in pair if d is not None])
+        it = iter(flat)
+        for h, (k_dev, v_dev) in items:
+            k_np = np.asarray(next(it))
+            v_np = np.asarray(next(it)) if v_dev is not None else np.zeros((0,), k_np.dtype)
+            self._cascade_put(h, k_np, v_np)
+        return len(items)
+
+    def _cascade_put(self, block_hash: int, k_np: np.ndarray, v_np: np.ndarray) -> None:
         spilled = self.host.put(block_hash, k_np, v_np)
         self.metrics.offloads_g2 += 1
         if spilled is not None and self.disk is not None:
@@ -123,6 +160,7 @@ class KvBlockManager:
         """Longest-prefix match across tiers. G1 blocks come back
         ref-acquired; deeper-tier hits come back as onboard candidates.
         The chain must stay contiguous: a tier miss ends the walk."""
+        self.flush_pending()  # pending snapshots become G2-visible here
         match = TieredMatch()
         g1 = self.allocator.match_prefix(block_hashes)
         match.g1_blocks = g1
@@ -191,6 +229,7 @@ class KvBlockManager:
         if level == CacheLevel.G1:
             return self.allocator.clear_cached()
         if level == CacheLevel.G2 and self.host is not None:
+            self._pending.clear()
             return self.host.clear()
         if level == CacheLevel.G3 and self.disk is not None:
             return self.disk.clear()

@@ -68,6 +68,21 @@ def gather_blocks(cache: KvCacheArrays, block_id: int) -> Tuple[np.ndarray, np.n
     return np.asarray(jax.device_get(k_dev)), np.asarray(jax.device_get(v_dev))
 
 
+def gather_blocks_async(cache: KvCacheArrays, block_id: int):
+    """Device-side snapshot of one block — NO host sync. The gather
+    dispatch is queued before any later write to the block (single device
+    stream), so the returned device arrays are a consistent copy even
+    though the caller reuses the block immediately; the host transfer
+    happens when the offload queue drains (KvbmManager.flush_pending)."""
+    if isinstance(cache.k, QuantKv):
+        return _gather_one_quant(cache.k, jnp.int32(block_id)), _gather_one_quant(
+            cache.v, jnp.int32(block_id)
+        )
+    if not _has_v(cache):
+        return _gather_k(cache.k, jnp.int32(block_id)), None
+    return _gather(cache.k, cache.v, jnp.int32(block_id))
+
+
 def scatter_blocks(cache: KvCacheArrays, block_id: int, k: np.ndarray, v: np.ndarray) -> None:
     """Host numpy → device block (in-place on the cache handle)."""
     if isinstance(cache.k, QuantKv):

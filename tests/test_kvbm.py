@@ -53,8 +53,11 @@ def test_offload_on_eviction_then_onboard():
     alloc.release(blocks)
     assert alloc.num_cached == 4
 
-    # Exhaust the pool: cached blocks evict → offload to G2.
+    # Exhaust the pool: cached blocks evict → offload snapshots queue
+    # (async — the device copy is dispatch-ordered, the host transfer
+    # batches at drain).
     got = alloc.allocate(4)
+    kvbm.flush_pending()
     assert kvbm.metrics.offloads_g2 == 4
     assert len(kvbm.host) == 4
     alloc.release(got)
@@ -92,6 +95,7 @@ def test_cascade_to_disk(tmp_path):
 
     # Evict all 4: host holds 2 (capacity), 2 spill to disk.
     alloc.allocate(4)
+    kvbm.flush_pending()
     assert kvbm.metrics.offloads_g2 == 4
     assert kvbm.metrics.offloads_g3 == 2
     assert len(kvbm.host) == 2 and len(kvbm.disk) == 2
@@ -203,6 +207,7 @@ async def test_g4_remote_tier_cross_worker():
                 # Evicting all 4 cascades: host holds 1, disk holds 1, the
                 # rest spill to G4 (remote).
                 alloc_a.allocate(4)
+                kvbm_a.flush_pending()
                 return contents
 
             contents = await asyncio.to_thread(worker_a_evicts)
