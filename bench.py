@@ -386,6 +386,24 @@ def _emit_partial(section: str, payload) -> None:
     print(PARTIAL_TAG + json.dumps({"section": section, "data": payload}), flush=True)
 
 
+def _run_cpu_subprocess(argv, key, timeout_s, extra_env=None):
+    """Run a CPU-pinned helper process and scan stdout for the JSON object
+    carrying ``key``. Returns (obj_or_None, error_or_None)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BENCH_CHILD", None)
+    env.update(extra_env or {})
+    out = subprocess.run(argv, env=env, capture_output=True, text=True, timeout=timeout_s)
+    for line in out.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and key in obj:
+                return obj, None
+        except ValueError:
+            pass
+    return None, f"no result (rc={out.returncode}): {out.stderr.strip()[-200:]}"
+
+
 def child_main() -> None:
     """Measurement process. Emits BENCH_PARTIAL lines per section and a full
     JSON line at the end; every section is individually fenced so one
@@ -516,23 +534,12 @@ def child_main() -> None:
     http = None
     if not skip_http and remaining() > 60:
         try:
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["BENCH_HTTP_ONLY"] = "1"
-            env.pop("BENCH_CHILD", None)
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=max(60, remaining() - 10),
+            http, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "tok_s",
+                max(60, remaining() - 10), extra_env={"BENCH_HTTP_ONLY": "1"},
             )
-            for line in out.stdout.splitlines():
-                try:
-                    obj = json.loads(line)
-                    if isinstance(obj, dict) and "tok_s" in obj:
-                        http = obj
-                except ValueError:
-                    pass
             if http is None:
-                errors.append(f"http_e2e: no result (rc={out.returncode}): {out.stderr.strip()[-200:]}")
+                errors.append(f"http_e2e: {err}")
             else:
                 _emit_partial("http_e2e", http)
         except subprocess.TimeoutExpired:
@@ -542,11 +549,32 @@ def child_main() -> None:
     elif not skip_http:
         errors.append("http_e2e skipped: budget")
 
+    # --- router benefit (mocker fleet, CPU subprocess) ----------------------
+    router_prefix = None
+    if not skip_http and remaining() > 60:
+        try:
+            router_prefix, err = _run_cpu_subprocess(
+                [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                              "tools", "bench_router_prefix.py"), "--quick"],
+                "sweep", max(60, remaining() - 10),
+            )
+            if router_prefix is not None:
+                _emit_partial("router_prefix", router_prefix)
+            else:
+                errors.append(f"router_prefix: {err}")
+        except subprocess.TimeoutExpired:
+            errors.append("router_prefix: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"router_prefix: {type(e).__name__}: {e}")
+    elif not skip_http:
+        errors.append("router_prefix skipped: budget")
+
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
-                              cpu_fallback, errors, tpu_http=tpu_http)), flush=True)
+                              cpu_fallback, errors, tpu_http=tpu_http,
+                              router_prefix=router_prefix)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -569,6 +597,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "prefill": prefill_detail,
             "tpu_http_e2e": tpu_http,
             "http_e2e": http,
+            "router_prefix": router_prefix,
             "device": device,
             "cpu_fallback": cpu_fallback,
             "errors": errors,
@@ -684,6 +713,7 @@ def main() -> None:
             os.environ.get("BENCH_MODEL", "llama-3.2-1b") if not cpu_fallback
             else os.environ.get("BENCH_MODEL_CPU", "tiny"),
             cpu_fallback, [], tpu_http=partials.get("tpu_http_e2e"),
+            router_prefix=partials.get("router_prefix"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
     final["detail"]["wall_s"] = round(time.time() - t_start, 1)

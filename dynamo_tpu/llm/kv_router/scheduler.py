@@ -81,8 +81,13 @@ class KvScheduler:
 
     def _softmax_sample(self, costs: List[Tuple[WorkerId, float, int]], temperature: float):
         if temperature <= 0.0:
-            # Deterministic: min cost, ties broken by worker id for stability.
-            return min(costs, key=lambda c: (c[1], c[0]))
+            # Deterministic best; EXACT ties break randomly — id-ordered
+            # tie-breaking concentrated every cold request onto one worker
+            # (measured: a serial warm pass put 8 prefix groups on a single
+            # mocker, evicting two of them, and KV routing then LOST to
+            # round-robin in tools/bench_router_prefix.py).
+            best = min(c[1] for c in costs)
+            return self.rng.choice([c for c in costs if c[1] == best])
         # softmax over -cost/temperature (ref: softmax_sample scheduler.rs:375)
         mx = max(-c[1] / temperature for c in costs)
         weights = [math.exp(-c[1] / temperature - mx) for c in costs]

@@ -125,6 +125,7 @@ class MockTpuEngine:
         self.request_total = 0
         self.prefill_tokens_done = 0
         self.preempt_total = 0
+        self.cached_tokens_total = 0  # prefix-cache hit tokens (hit-rate telemetry)
         self.last_step_ms = 0.0  # most recent simulated step duration
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
@@ -262,6 +263,10 @@ class MockTpuEngine:
                 seq.computed = 0
                 seq.cached_tokens = 0
                 return 0
+            # Count hits only on a COMMITTED first touch — a rolled-back
+            # admission retries and would double-count (which inflated the
+            # thrash-prone policy's hit rate in bench_router_prefix).
+            self.cached_tokens_total += seq.cached_tokens
         remaining = seq.prefill_span - seq.computed
         chunk = min(remaining, args.max_prefill_chunk)
         seq.computed += chunk
