@@ -88,6 +88,14 @@ class ModelConfig:
     # Covers llama KV and MLA latent rows (per-token scale over the latent).
     # Ref role: the engines' --kv-cache-dtype fp8 levers.
     kv_cache_dtype: str = "auto"
+    # Weight storage dtype: "int8" stores dense layer matmul weights as
+    # int8 + per-output-channel scale, dequantized one layer at a time in
+    # the scan (engine/quant.py) — ~2× model capacity per HBM byte.
+    # Measured necessity: Llama-3-8B bf16 is 15.0 GiB of weights and OOMs
+    # a 16 GiB v5e before the first decode step; int8 weights serve it.
+    # Embed/lm_head stay in compute dtype (per-step re-dequant of a
+    # vocab-size matrix would add ~1 GB/token of traffic at 8B).
+    weight_dtype: str = "auto"
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "gather", "paged"):
@@ -107,6 +115,18 @@ class ModelConfig:
             )
         if self.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(f"kv_cache_dtype must be auto|int8, got {self.kv_cache_dtype!r}")
+        if self.weight_dtype not in ("auto", "int8"):
+            raise ValueError(f"weight_dtype must be auto|int8, got {self.weight_dtype!r}")
+        if self.weight_dtype == "int8" and self.architecture != "llama":
+            raise ValueError(
+                "weight_dtype='int8' is llama-family only (MLA layer scans "
+                "do not dequantize yet)"
+            )
+        if self.weight_dtype == "int8" and self.num_experts > 0:
+            raise ValueError(
+                "weight_dtype='int8' does not cover MoE expert stacks "
+                "(ragged/capacity dispatch would re-dequantize per expert)"
+            )
 
     @property
     def q_size(self) -> int:

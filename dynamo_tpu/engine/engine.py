@@ -65,6 +65,8 @@ class EngineArgs:
     spec_gamma: int = 4
     # KV cache storage dtype override ("auto" | "int8") — config.py.
     kv_cache_dtype: str = "auto"
+    # Weight storage dtype override ("auto" | "int8") — config.py weight_dtype.
+    weight_dtype: str = "auto"
     # Precompile serving-hot executables for contexts up to this many tokens
     # before taking traffic (scheduler.warmup; 0 = skip). Without it, every
     # new (batch bucket × table width) shape compiles mid-request — measured
@@ -100,6 +102,8 @@ class TpuEngine:
         mc = args.model_config or get_config(args.model)
         if args.kv_cache_dtype != "auto":
             mc = mc.replace(kv_cache_dtype=args.kv_cache_dtype)
+        if args.weight_dtype != "auto":
+            mc = mc.replace(weight_dtype=args.weight_dtype)
         dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
         if params is None:
             if args.checkpoint_path:
@@ -111,6 +115,12 @@ class TpuEngine:
 
                 logger.warning("no checkpoint: initializing random weights for %s", mc.name)
                 params = get_module(mc).init_params(mc, jax.random.PRNGKey(args.seed), dtype=dtype)
+        if mc.weight_dtype == "int8":
+            from dynamo_tpu.engine.quant import params_quantized, quantize_params
+
+            if not params_quantized(params):
+                params = quantize_params(params)
+                logger.info("int8 weight-only quantization applied (layer matmul weights)")
         mesh = None
         if args.parallel is not None and args.parallel.total > 1:
             from dynamo_tpu.engine.sharding import build_mesh

@@ -28,6 +28,7 @@ from jax import lax
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.kv_cache import QuantKv, quantize_kv_rows
+from dynamo_tpu.engine.quant import dequant_layer
 
 Params = Dict[str, jax.Array]
 
@@ -475,6 +476,7 @@ def prefill(
 
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
@@ -757,6 +759,7 @@ def _decode_layer_scan_window(
             lp, l, kwl, vwl, k_ctx, v_ctx = xs
         else:
             lp, l, kwl, vwl = xs  # kwl/vwl: [w, B, KVH, HD] this layer's window rows
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
@@ -873,6 +876,7 @@ def chunk_decode(
 
     def layer_fn(h, xs):
         lp, l = xs
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, S, c.num_heads, hd)
         k = (x @ lp["wk"]).reshape(B, S, kvh, hd)
@@ -975,6 +979,7 @@ def embed(
     mask = (positions[None, :] <= positions[:, None]) & valid[None, :]
 
     def layer_fn(h, lp):
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = apply_rope((x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim), positions, c.rope_theta)
         k = apply_rope((x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim), positions, c.rope_theta)
@@ -1062,6 +1067,7 @@ def decode_layer_scan(
 
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index within this stack
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
         x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
         k = (x @ lp["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)

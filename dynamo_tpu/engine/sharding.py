@@ -107,11 +107,27 @@ def kv_cache_spec(num_kv_heads: int = 0, tp_size: int = 1, pp: bool = False) -> 
 
 def shard_params(params, mesh: Mesh, tie_word_embeddings: bool, num_experts: int = 0, pp: bool = False):
     specs = param_specs(tie_word_embeddings, num_experts, pp=pp)
+
+    def _put(x, s):
+        from dynamo_tpu.engine.quant import QuantW
+
+        if isinstance(x, QuantW):
+            # int8 codes take the weight's spec; the per-output-channel
+            # scale [..., 1, out] keeps only the spec's LAST axis (its
+            # other axes are size-1 or layer-stacked and must not shard a
+            # unit dimension).
+            s_scale = P(*([None] * (len(s) - 1) + [s[-1]])) if len(s) else s
+            return QuantW(
+                jax.device_put(x.q, NamedSharding(mesh, s)),
+                jax.device_put(x.scale, NamedSharding(mesh, s_scale)),
+            )
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    from dynamo_tpu.engine.quant import QuantW
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params,
-        specs,
-        is_leaf=lambda x: isinstance(x, jax.Array),
+        _put, params, specs,
+        is_leaf=lambda x: isinstance(x, (jax.Array, QuantW)),
     )
 
 

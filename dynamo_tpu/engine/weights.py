@@ -61,12 +61,21 @@ def load_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
     c = config
     L = c.num_layers
 
-    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+    quant_int8 = c.weight_dtype == "int8"
+
+    def stack(fmt: str, transpose: bool = True, quantizable: bool = False):
         # HF nn.Linear stores [out, in]; our layout is [in, out].
         layers = [raw[fmt.format(l)] for l in range(L)]
         arr = np.stack(layers)
         if transpose:
             arr = arr.transpose(0, 2, 1)
+        if quantizable and quant_int8:
+            # Quantize on HOST: the bf16 stack never lands on the device,
+            # so checkpoints bigger than HBM in full precision (8B on a
+            # 16 GiB v5e) load directly into int8 residency.
+            from dynamo_tpu.engine.quant import quantize_weight_np
+
+            return quantize_weight_np(arr)
         return jnp.asarray(arr, dtype=dtype)
 
     params = {
@@ -79,13 +88,13 @@ def load_checkpoint(path: str, config: ModelConfig, dtype=jnp.bfloat16) -> Dict:
             "mlp_norm": jnp.asarray(
                 np.stack([raw[f"model.layers.{l}.post_attention_layernorm.weight"] for l in range(L)]), dtype=dtype
             ),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", quantizable=True),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", quantizable=True),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", quantizable=True),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", quantizable=True),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", quantizable=True),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", quantizable=True),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight", quantizable=True),
         },
     }
     if not c.tie_word_embeddings and "lm_head.weight" in raw:

@@ -46,6 +46,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--kv-cache-dtype", choices=["auto", "int8"], default="auto",
                    help="int8 halves KV memory/bytes (llama gather path)")
+    p.add_argument("--weight-dtype", choices=["auto", "int8"], default="auto",
+                   help="int8 stores layer matmul weights quantized (2x model capacity per HBM byte)")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--draft-model", default=None,
@@ -130,6 +132,7 @@ async def amain(args) -> None:
                 draft_checkpoint_path=args.draft_checkpoint,
                 spec_gamma=args.spec_gamma,
                 kv_cache_dtype=args.kv_cache_dtype,
+                weight_dtype=args.weight_dtype,
             )
         )
         if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
