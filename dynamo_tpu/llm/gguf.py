@@ -163,10 +163,18 @@ def parse_gguf(path: str, *, max_array: int = 1 << 24) -> GgufMetadata:
 
 
 # --- tensor data loading ----------------------------------------------------
-# Real-valued + q8_0 coverage: what llama.cpp emits for f32/f16/bf16 exports
-# and the simplest quantized format. Other quants raise (convert externally).
+# Real-valued + q8_0 + k-quant coverage (q4_k/q5_k/q6_k are what most
+# published GGUF checkpoints actually ship as — ref: lib/llm/src/gguf/ +
+# lib/engines/llamacpp serve the full llama.cpp range). Remaining exotic
+# quants (iq*, q2/q3_k) raise — convert externally.
 
 GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
+QK_K = 256  # k-quant super-block size
+
+# Bytes per QK_K super-block: q4_k = d,dmin(2×f16) + scales(12) + qs(128);
+# q5_k adds qh(32); q6_k = ql(128) + qh(64) + scales(16×i8) + d(f16).
+_KQUANT_BLOCK_BYTES = {GGML_Q4_K: 144, GGML_Q5_K: 176, GGML_Q6_K: 210}
 
 
 def _tensor_nbytes(info: GgufTensorInfo) -> int:
@@ -181,10 +189,94 @@ def _tensor_nbytes(info: GgufTensorInfo) -> int:
         if n % 32:
             raise GgufError(f"{info.name}: q8_0 needs multiple-of-32 elements")
         return (n // 32) * 34  # f16 scale + 32 int8 codes per block
+    if info.ggml_type in _KQUANT_BLOCK_BYTES:
+        if n % QK_K:
+            raise GgufError(f"{info.name}: k-quants need multiple-of-{QK_K} elements")
+        return (n // QK_K) * _KQUANT_BLOCK_BYTES[info.ggml_type]
     raise GgufError(
         f"{info.name}: unsupported tensor dtype {info.dtype_name} "
-        "(supported: f32, f16, bf16, q8_0)"
+        "(supported: f32, f16, bf16, q8_0, q4_k, q5_k, q6_k)"
     )
+
+
+def _scale_min_k4(scales):
+    """Unpack q4_k/q5_k packed 6-bit (scale, min) pairs: [nb, 12] uint8 →
+    two [nb, 8] float32 arrays (llama.cpp get_scale_min_k4 layout)."""
+    import numpy as np
+
+    s = scales.astype(np.uint8)
+    sc = np.empty(s.shape[:-1] + (8,), np.float32)
+    mn = np.empty_like(sc)
+    sc[..., :4] = (s[..., 0:4] & 63).astype(np.float32)
+    mn[..., :4] = (s[..., 4:8] & 63).astype(np.float32)
+    sc[..., 4:] = ((s[..., 8:12] & 0xF) | ((s[..., 0:4] >> 6) << 4)).astype(np.float32)
+    mn[..., 4:] = ((s[..., 8:12] >> 4) | ((s[..., 4:8] >> 6) << 4)).astype(np.float32)
+    return sc, mn
+
+
+def _dequant_q4_k(raw):
+    import numpy as np
+
+    b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 144)
+    d = b[:, 0:2].copy().view(np.float16).astype(np.float32)  # [nb, 1]
+    dmin = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _scale_min_k4(b[:, 4:16])  # [nb, 8]
+    qs = b[:, 16:144]  # [nb, 128] — nibbles for 8 sub-blocks of 32
+    lo = (qs & 0xF).astype(np.float32).reshape(-1, 4, 32)  # sub-blocks 0,2,4,6
+    hi = (qs >> 4).astype(np.float32).reshape(-1, 4, 32)  # sub-blocks 1,3,5,7
+    out = np.empty((b.shape[0], 8, 32), np.float32)
+    out[:, 0::2] = d[:, :, None] * sc[:, 0::2, None] * lo - dmin[:, :, None] * mn[:, 0::2, None]
+    out[:, 1::2] = d[:, :, None] * sc[:, 1::2, None] * hi - dmin[:, :, None] * mn[:, 1::2, None]
+    return out.reshape(-1)
+
+
+def _dequant_q5_k(raw):
+    import numpy as np
+
+    b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 176)
+    d = b[:, 0:2].copy().view(np.float16).astype(np.float32)
+    dmin = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _scale_min_k4(b[:, 4:16])
+    qh = b[:, 16:48]  # [nb, 32] — one high bit per element per 32-lane
+    qs = b[:, 48:176]  # [nb, 128]
+    lo = (qs & 0xF).astype(np.uint8).reshape(-1, 4, 32)
+    hi = (qs >> 4).astype(np.uint8).reshape(-1, 4, 32)
+    out = np.empty((b.shape[0], 8, 32), np.float32)
+    for j in range(4):  # 64-element chunks; qh bit pairs (2j, 2j+1)
+        h1 = ((qh >> (2 * j)) & 1).astype(np.uint8)  # [nb, 32]
+        h2 = ((qh >> (2 * j + 1)) & 1).astype(np.uint8)
+        q1 = (lo[:, j] | (h1 << 4)).astype(np.float32)
+        q2 = (hi[:, j] | (h2 << 4)).astype(np.float32)
+        out[:, 2 * j] = d * sc[:, 2 * j : 2 * j + 1] * q1 - dmin * mn[:, 2 * j : 2 * j + 1]
+        out[:, 2 * j + 1] = d * sc[:, 2 * j + 1 : 2 * j + 2] * q2 - dmin * mn[:, 2 * j + 1 : 2 * j + 2]
+    return out.reshape(-1)
+
+
+def _dequant_q6_k(raw):
+    import numpy as np
+
+    b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 210)
+    ql = b[:, 0:128].reshape(-1, 2, 64)  # two 128-element halves
+    qh = b[:, 128:192].reshape(-1, 2, 32)
+    sc = b[:, 192:208].copy().view(np.int8).astype(np.float32).reshape(-1, 2, 8)
+    d = b[:, 208:210].copy().view(np.float16).astype(np.float32)  # [nb, 1]
+    out = np.empty((b.shape[0], 2, 4, 32), np.float32)
+    for half in range(2):
+        l_lo = (ql[:, half, :32] & 0xF).astype(np.int16)
+        l2_lo = (ql[:, half, 32:] & 0xF).astype(np.int16)
+        l_hi = (ql[:, half, :32] >> 4).astype(np.int16)
+        l2_hi = (ql[:, half, 32:] >> 4).astype(np.int16)
+        h = qh[:, half].astype(np.int16)
+        q1 = (l_lo | ((h & 3) << 4)) - 32
+        q2 = (l2_lo | (((h >> 2) & 3) << 4)) - 32
+        q3 = (l_hi | (((h >> 4) & 3) << 4)) - 32
+        q4 = (l2_hi | (((h >> 6) & 3) << 4)) - 32
+        # scale index: l//16 + {0,2,4,6} over the 8 per-half scales
+        s = sc[:, half]  # [nb, 8]
+        for qi, (q, off) in enumerate(((q1, 0), (q2, 2), (q3, 4), (q4, 6))):
+            scale = np.repeat(s[:, off : off + 2], 16, axis=1)  # [nb, 32]
+            out[:, half, qi] = d * scale * q.astype(np.float32)
+    return out.reshape(-1)
 
 
 def read_tensor(f: BinaryIO, meta: GgufMetadata, info: GgufTensorInfo):
@@ -203,6 +295,12 @@ def read_tensor(f: BinaryIO, meta: GgufMetadata, info: GgufTensorInfo):
     elif info.ggml_type == GGML_BF16:
         u = np.frombuffer(raw, dtype=np.uint16).astype(np.uint32) << 16
         arr = u.view(np.float32)
+    elif info.ggml_type == GGML_Q4_K:
+        arr = _dequant_q4_k(raw)
+    elif info.ggml_type == GGML_Q5_K:
+        arr = _dequant_q5_k(raw)
+    elif info.ggml_type == GGML_Q6_K:
+        arr = _dequant_q6_k(raw)
     else:  # q8_0
         blocks = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 34)
         scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)  # [nb, 1]
