@@ -468,9 +468,10 @@ def child_main() -> None:
         except Exception as e:  # noqa: BLE001 — a failed point must not kill the sweep
             errors.append(f"decode b{batch}: {type(e).__name__}: {e}")
 
-    # --- int8 KV point (capacity ×2; opt-in — decode latency is at best at
-    # parity on current XLA:TPU, see models/llama.py:_gather_kv) -------------
-    if os.environ.get("BENCH_INT8") == "1" and not cpu_fallback and decode_points and remaining() > 90:
+    # --- int8 KV point (capacity ×2; ON by default, BENCH_INT8=0 opts out —
+    # decode latency is at best at parity on current XLA:TPU, the point
+    # records the capacity configuration; see models/llama.py:_gather_kv) ---
+    if os.environ.get("BENCH_INT8", "1") == "1" and not cpu_fallback and decode_points and remaining() > 90:
         try:
             b8 = batches[0]
             cfg8 = cfg.replace(kv_cache_dtype="int8", attention_impl="gather")

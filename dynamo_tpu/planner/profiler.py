@@ -11,7 +11,7 @@ from __future__ import annotations
 import argparse
 import os
 import time
-from typing import List
+from typing import List, Sequence
 
 import numpy as np
 
@@ -49,7 +49,7 @@ def profile_prefill(model: str, isls: List[int], dtype: str = "bfloat16") -> dic
     return rows
 
 
-def profile_decode(model: str, batches: List[int], ctx: int = 1024, dtype: str = "bfloat16") -> dict:
+def profile_decode(model: str, batches: List[int], ctxs: Sequence[int] = (1024,), dtype: str = "bfloat16") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -58,32 +58,38 @@ def profile_decode(model: str, batches: List[int], ctx: int = 1024, dtype: str =
     from dynamo_tpu.engine.models import llama
 
     cfg = get_config(model)
-    ctx = min(ctx, cfg.max_seq_len - cfg.block_size)
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
     rows = {"active_kv": [], "context_len": [], "itl_ms": [], "thpt_per_chip": []}
-    for B in batches:
-        blocks_per_seq = ctx // cfg.block_size + 2
-        num_blocks = B * blocks_per_seq + 1
-        cache = KvCacheArrays.create(cfg, num_blocks=num_blocks)
-        tables = jnp.stack(
-            [jnp.arange(1 + i * blocks_per_seq, 1 + (i + 1) * blocks_per_seq, dtype=jnp.int32) for i in range(B)]
-        )
-        toks = jnp.zeros((B,), dtype=jnp.int32)
-        pos = jnp.full((B,), ctx, dtype=jnp.int32)
-        active = jnp.ones((B,), dtype=bool)
-        fn = jax.jit(lambda p, k, v, t: llama.decode(p, cfg, k, v, t, pos, tables, active), donate_argnums=(1, 2))
-        logits, k, v = fn(params, cache.k, cache.v, toks)
-        logits.block_until_ready()
-        t0 = time.perf_counter()
-        n = 8
-        for _ in range(n):
-            logits, k, v = fn(params, k, v, toks)
-        logits.block_until_ready()
-        dt = (time.perf_counter() - t0) / n
-        rows["active_kv"].append(B * blocks_per_seq)
-        rows["context_len"].append(ctx)
-        rows["itl_ms"].append(dt * 1000)
-        rows["thpt_per_chip"].append(B / dt)
+    # GRID over (batch, context): the ITL surface the SLA math inverts is
+    # two-dimensional (ref profile_sla.py sweeps both; a single-ctx line
+    # cannot price long-context decode).
+    # Dedup after clamping: on short-context models several requested ctxs
+    # clamp to the same value and would write duplicate noisy grid points
+    # (DecodeInterpolator's exact-match branch then picks one arbitrarily).
+    for ctx in sorted({min(int(c), cfg.max_seq_len - cfg.block_size) for c in ctxs}):
+      for B in batches:
+          blocks_per_seq = ctx // cfg.block_size + 2
+          num_blocks = B * blocks_per_seq + 1
+          cache = KvCacheArrays.create(cfg, num_blocks=num_blocks)
+          tables = jnp.stack(
+              [jnp.arange(1 + i * blocks_per_seq, 1 + (i + 1) * blocks_per_seq, dtype=jnp.int32) for i in range(B)]
+          )
+          toks = jnp.zeros((B,), dtype=jnp.int32)
+          pos = jnp.full((B,), ctx, dtype=jnp.int32)
+          active = jnp.ones((B,), dtype=bool)
+          fn = jax.jit(lambda p, k, v, t: llama.decode(p, cfg, k, v, t, pos, tables, active), donate_argnums=(1, 2))
+          logits, k, v = fn(params, cache.k, cache.v, toks)
+          logits.block_until_ready()
+          t0 = time.perf_counter()
+          n = 8
+          for _ in range(n):
+              logits, k, v = fn(params, k, v, toks)
+          logits.block_until_ready()
+          dt = (time.perf_counter() - t0) / n
+          rows["active_kv"].append(B * blocks_per_seq)
+          rows["context_len"].append(ctx)
+          rows["itl_ms"].append(dt * 1000)
+          rows["thpt_per_chip"].append(B / dt)
     return rows
 
 
@@ -93,12 +99,12 @@ def main() -> None:
     p.add_argument("--out", default="profiles")
     p.add_argument("--isls", type=int, nargs="+", default=[128, 256, 512, 1024])
     p.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
-    p.add_argument("--ctx", type=int, default=1024)
+    p.add_argument("--ctxs", type=int, nargs="+", default=[512, 1024, 2048])
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
     pre = profile_prefill(args.model, args.isls)
     np.savez(os.path.join(args.out, f"prefill_{args.model}.npz"), **{k: np.asarray(v) for k, v in pre.items()})
-    dec = profile_decode(args.model, args.batches, args.ctx)
+    dec = profile_decode(args.model, args.batches, args.ctxs)
     np.savez(os.path.join(args.out, f"decode_{args.model}.npz"), **{k: np.asarray(v) for k, v in dec.items()})
     print(f"profiles written to {args.out}/: prefill {pre['ttft_ms']} ms, decode {dec['itl_ms']} ms")
 

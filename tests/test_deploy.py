@@ -204,3 +204,115 @@ async def test_kubernetes_connector_deployment_scaling(tmp_path):
     conn = KubernetesConnector(namespace="ns", kubectl_cmd=[str(stub)])
     await conn.set_replicas("decode", 2)
     assert "scale deployment/dynamo-decode --replicas=2" in log.read_text()
+
+
+# --- reconciler (in-cluster operator loop) ---------------------------------
+
+FAKE_KUBE = '''#!/usr/bin/env python3
+"""Stub kube API: state in a JSON file, kubectl-shaped argv."""
+import json, sys
+
+STATE = {state_path!r}
+
+def load():
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {{"dgds": {{}}, "deployments": {{}}}}
+
+def save(s):
+    with open(STATE, "w") as f:
+        json.dump(s, f)
+
+args = sys.argv[1:]
+assert args[0] == "-n"
+args = args[2:]  # drop -n <ns>
+s = load()
+if args[:2] == ["get", "dynamographdeployments"]:
+    print(json.dumps({{"items": list(s["dgds"].values())}}))
+elif args[:2] == ["get", "deployments"]:
+    print(json.dumps({{"items": list(s["deployments"].values())}}))
+elif args[:3] == ["apply", "-f", "-"]:
+    man = json.loads(sys.stdin.read())
+    name = man["metadata"]["name"]
+    # simulate the cluster converging instantly: ready == desired
+    man.setdefault("status", {{}})["readyReplicas"] = man["spec"]["replicas"]
+    s["deployments"][name] = man
+    save(s)
+elif args[:2] == ["delete", "deployment"]:
+    s["deployments"].pop(args[2], None)
+    save(s)
+elif args[:2] == ["patch", "dynamographdeployment"]:
+    name = args[2]
+    patch = json.loads(args[args.index("-p") + 1])
+    s["dgds"][name].update(patch)
+    save(s)
+else:
+    sys.exit("unhandled: " + " ".join(args))
+'''
+
+
+async def test_reconciler_create_scale_delete_roundtrip(tmp_path):
+    """VERDICT r4 #10: the reconcile loop drives create → scale → delete
+    against a stubbed kubectl, round-tripping status onto the CR."""
+    import json
+
+    from dynamo_tpu.deploy.crd import graph_to_cr
+    from dynamo_tpu.deploy.reconciler import KubeReconciler
+    from dynamo_tpu.deploy.spec import GraphDeployment, ServiceSpec
+
+    state = tmp_path / "kube.json"
+    stub = tmp_path / "kubectl"
+    stub.write_text(FAKE_KUBE.format(state_path=str(state)))
+    stub.chmod(0o755)
+
+    graph = GraphDeployment(
+        name="g1",
+        namespace="prod",
+        services={
+            "frontend": ServiceSpec(name="frontend", command=["fe"], replicas=1),
+            "decode": ServiceSpec(name="decode", command=["wk"], replicas=2),
+        },
+    )
+    state.write_text(json.dumps({"dgds": {"g1": graph_to_cr(graph)}, "deployments": {}}))
+
+    rec = KubeReconciler(namespace="prod", kubectl_cmd=[str(stub)], image="img:1")
+
+    # 1. CREATE: both deployments materialize; status lands on the CR.
+    await rec.reconcile_once()
+    s = json.loads(state.read_text())
+    assert set(s["deployments"]) == {"g1-frontend", "g1-decode"}
+    assert s["deployments"]["g1-decode"]["spec"]["replicas"] == 2
+    st = s["dgds"]["g1"]["status"]
+    assert st["services"]["decode"]["desired"] == 2
+    # First pass saw no live deployments yet → not Ready.
+    assert st["conditions"][0]["status"] == "False"
+
+    # 2. Second pass observes readiness → Ready condition flips true.
+    await rec.reconcile_once()
+    st = json.loads(state.read_text())["dgds"]["g1"]["status"]
+    assert st["services"]["decode"]["ready"] == 2
+    assert st["conditions"][0]["status"] == "True"
+
+    # 3. SCALE: bump decode 2 → 5 in the CR spec; reconcile converges.
+    s = json.loads(state.read_text())
+    s["dgds"]["g1"]["spec"]["services"]["decode"]["replicas"] = 5
+    state.write_text(json.dumps(s))
+    await rec.reconcile_once()
+    s = json.loads(state.read_text())
+    assert s["deployments"]["g1-decode"]["spec"]["replicas"] == 5
+    assert s["dgds"]["g1"]["status"]["services"]["decode"]["desired"] == 5
+
+    # 4. Service removed from the spec → its deployment is deleted.
+    s["dgds"]["g1"]["spec"]["services"].pop("frontend")
+    state.write_text(json.dumps(s))
+    await rec.reconcile_once()
+    s = json.loads(state.read_text())
+    assert set(s["deployments"]) == {"g1-decode"}
+
+    # 5. CR deleted → orphan sweep removes everything it managed.
+    s["dgds"].pop("g1")
+    state.write_text(json.dumps(s))
+    await rec.reconcile_once()
+    assert json.loads(state.read_text())["deployments"] == {}

@@ -1,0 +1,35 @@
+"""The Grafana dashboard must reference only metric families the code
+actually registers (panels silently show 'no data' otherwise — the failure
+mode that makes dashboards rot)."""
+
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dashboard_metrics_exist_in_code():
+    with open(os.path.join(REPO, "deploy", "grafana", "dynamo_tpu_serving.json")) as f:
+        dash = json.load(f)
+    assert dash["panels"], "dashboard has no panels"
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    families = set()
+    for e in exprs:
+        for m in re.findall(r"dynamo_[a-z_]+", e):
+            families.add(re.sub(r"_(bucket|sum|count)$", "", m))
+
+    # Registered names: frontend metrics in llm/http/service.py (prefix
+    # dynamo_frontend_), worker fields forwarded by metrics_aggregator
+    # (prefix dynamo_component_).
+    src = open(os.path.join(REPO, "dynamo_tpu", "llm", "http", "service.py")).read()
+    agg = open(os.path.join(REPO, "dynamo_tpu", "metrics_aggregator.py")).read()
+    for fam in families:
+        if fam.startswith("dynamo_frontend_"):
+            short = fam[len("dynamo_frontend_"):]
+            assert f'"{short}"' in src, f"dashboard references unregistered {fam}"
+        elif fam.startswith("dynamo_component_"):
+            short = fam[len("dynamo_component_"):]
+            assert short in agg, f"dashboard references unforwarded {fam}"
+        else:
+            raise AssertionError(f"unknown metric prefix: {fam}")

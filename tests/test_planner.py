@@ -149,3 +149,49 @@ async def test_planner_scaling_e2e_with_local_connector():
         await connector.shutdown()
     finally:
         await drt.shutdown()
+
+
+def test_planner_e2e_against_profiled_surfaces(tmp_path):
+    """The full SLA loop against MEASURED (not hardcoded) surfaces: run the
+    profiler on the tiny model, persist npz, load interpolators from disk,
+    and drive replica math with a bursty load generator. Ref:
+    benchmarks/profiler/profile_sla.py + pre_deployment_profiling.md:60-84."""
+    import numpy as np
+
+    from dynamo_tpu.planner.profiler import profile_decode, profile_prefill
+
+    pre = profile_prefill("tiny", isls=[32, 64, 128])
+    dec = profile_decode("tiny", batches=[1, 2, 4], ctxs=[64, 128])
+    np.savez(tmp_path / "prefill.npz", **{k: np.asarray(v) for k, v in pre.items()})
+    np.savez(tmp_path / "decode.npz", **{k: np.asarray(v) for k, v in dec.items()})
+
+    prefill = PrefillInterpolator.from_npz(str(tmp_path / "prefill.npz"))
+    decode = DecodeInterpolator.from_npz(str(tmp_path / "decode.npz"))
+
+    # The decode surface is a real 2D grid.
+    assert len(set(dec["context_len"])) == 2
+    assert len(dec["itl_ms"]) == 6
+
+    # Monotonicity sanity on the measured fits inside the profiled range.
+    assert prefill.ttft_ms(128) >= prefill.ttft_ms(32) * 0.5
+    itl_small = decode.itl_ms(dec["active_kv"][0], 64)
+    itl_big = decode.itl_ms(dec["active_kv"][-1], 128)
+    assert itl_big > 0 and itl_small > 0
+
+    cfg = PlannerConfig(
+        max_chip_budget=64,
+        sla=SlaTargets(itl_ms=max(itl_big * 1.5, 1.0), ttft_ms=prefill.ttft_ms(128) * 4),
+    )
+    planner = Planner(cfg, VirtualConnector(), prefill, decode, observe_fn=None)
+
+    # Load generator: a bursty day — ramp, spike, decay. Replica plans must
+    # track the rate monotonically and stay within budget.
+    rates = [0.5, 2.0, 8.0, 20.0, 6.0, 1.0]
+    plans = [
+        planner.compute_replicas(ObservedLoad(request_rate=r, avg_isl=96, avg_osl=32))
+        for r in rates
+    ]
+    totals = [p.prefill + p.decode for p in plans]
+    assert totals[3] == max(totals), "spike must size the largest fleet"
+    assert all(1 <= t <= 64 for t in totals)
+    assert totals[0] <= totals[2] <= totals[3]
