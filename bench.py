@@ -169,7 +169,7 @@ def bench_prefill(cfg, params, prompt_len):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_tpu_http(n_requests=96, concurrency=32, tokens_out=32, isl=96):
+def bench_tpu_http(n_requests=64, concurrency=32, tokens_out=32, isl=96):
     """Full serving stack with the FLAGSHIP model on the real chip: HTTP →
     preprocess → scheduler (TPU decode windows) → detokenize → SSE. The r4
     artifact measured the engine on TPU and the serving plane on CPU, never
@@ -365,7 +365,7 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
             # first level by ~6x when warmed with a single request).
             await asyncio.gather(*[one(session, -i) for i in range(1, 17)])
             sweep = []
-            for conc in (concurrency, 32, 64, 128):
+            for conc in (concurrency, 32, 128):
                 if sweep and sweep[-1]["concurrency"] >= conc:
                     continue
                 sweep.append(await level(session, conc, max(n_requests, 3 * conc)))
@@ -475,7 +475,7 @@ def child_main() -> None:
         try:
             b8 = batches[0]
             cfg8 = cfg.replace(kv_cache_dtype="int8", attention_impl="gather")
-            step_s = bench_decode(cfg8, params, b8, ctx_len, steps, window)
+            step_s = bench_decode(cfg8, params, b8, ctx_len, max(64, steps // 4), window)
             kv_bytes = cfg.num_layers * ctx_len * cfg.num_kv_heads * cfg.head_dim * 2 * b8  # int8 k+v
             gbps = (pbytes + kv_bytes) / step_s / 1e9
             point = {
@@ -579,7 +579,7 @@ def child_main() -> None:
                 },
             }
             pts = []
-            for b8b in (8, 16):
+            for b8b in (8,):
                 if remaining() < 60:
                     errors.append(f"8B point b{b8b} skipped: budget")
                     break
@@ -611,6 +611,26 @@ def child_main() -> None:
     elif not cpu_fallback and os.environ.get("BENCH_SKIP_8B") != "1":
         errors.append("8B section skipped: budget")
 
+    # --- router benefit (mocker fleet, CPU subprocess) ----------------------
+    router_prefix = None
+    if not skip_http and remaining() > 60:
+        try:
+            router_prefix, err = _run_cpu_subprocess(
+                [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                              "tools", "bench_router_prefix.py"), "--quick"],
+                "sweep", max(60, remaining() - 10),
+            )
+            if router_prefix is not None:
+                _emit_partial("router_prefix", router_prefix)
+            else:
+                errors.append(f"router_prefix: {err}")
+        except subprocess.TimeoutExpired:
+            errors.append("router_prefix: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"router_prefix: {type(e).__name__}: {e}")
+    elif not skip_http:
+        errors.append("router_prefix skipped: budget")
+
     # --- HTTP e2e (serving stack, tiny model) -------------------------------
     # Runs in a CPU subprocess: the section measures the serving plane
     # (HTTP/preprocess/scheduler-loop/detok overhead), and routing tiny-model
@@ -633,25 +653,6 @@ def child_main() -> None:
     elif not skip_http:
         errors.append("http_e2e skipped: budget")
 
-    # --- router benefit (mocker fleet, CPU subprocess) ----------------------
-    router_prefix = None
-    if not skip_http and remaining() > 60:
-        try:
-            router_prefix, err = _run_cpu_subprocess(
-                [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                              "tools", "bench_router_prefix.py"), "--quick"],
-                "sweep", max(60, remaining() - 10),
-            )
-            if router_prefix is not None:
-                _emit_partial("router_prefix", router_prefix)
-            else:
-                errors.append(f"router_prefix: {err}")
-        except subprocess.TimeoutExpired:
-            errors.append("router_prefix: subprocess timed out")
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"router_prefix: {type(e).__name__}: {e}")
-    elif not skip_http:
-        errors.append("router_prefix skipped: budget")
 
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
                               cpu_fallback, errors, tpu_http=tpu_http,
