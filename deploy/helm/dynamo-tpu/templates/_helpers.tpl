@@ -1,0 +1,4 @@
+{{- define "dynamo-tpu.labels" -}}
+app.kubernetes.io/part-of: dynamo-tpu
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
