@@ -369,7 +369,6 @@ class Scheduler:
                 {w for w in (8, 16, self.sc.num_scheduler_steps) if w <= self.sc.num_scheduler_steps}
             )
             self._decode_multi_jits = {w: mk_multi(w) for w in self._window_rungs}
-            self._decode_multi_jit = self._decode_multi_jits[self._window_rungs[-1]]
 
     def attach_draft(self, draft_config: ModelConfig, draft_params, *, gamma: int = 4) -> None:
         """Enable batched speculative decoding: the draft model proposes γ
