@@ -365,7 +365,7 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
             # first level by ~6x when warmed with a single request).
             await asyncio.gather(*[one(session, -i) for i in range(1, 17)])
             sweep = []
-            for conc in (concurrency, 32, 128):
+            for conc in (concurrency, 64):
                 if sweep and sweep[-1]["concurrency"] >= conc:
                     continue
                 sweep.append(await level(session, conc, max(n_requests, 3 * conc)))
@@ -490,6 +490,39 @@ def child_main() -> None:
             _emit_partial("decode_point", point)
         except Exception as e:  # noqa: BLE001
             errors.append(f"decode int8: {type(e).__name__}: {e}")
+
+    # --- int8-WEIGHT point (weight-only quant speeds decode outright:
+    # layer weights stream at half the bytes and XLA fuses the dequant into
+    # the matmul reads — measured, see engine/quant.py) ----------------------
+    if os.environ.get("BENCH_INT8W", "1") == "1" and not cpu_fallback and decode_points and remaining() > 90:
+        params_q = None
+        try:
+            from dynamo_tpu.engine.quant import quantize_params
+
+            b8 = batches[0]
+            # quantize_params mutates in place — hand it a copied layers
+            # dict so the bf16 tree stays intact for the prefill section.
+            params_q = quantize_params({**params, "layers": dict(params["layers"])})
+            step_s = bench_decode(cfg, params_q, b8, ctx_len, max(64, steps // 4), window)
+            qbytes = param_bytes_of(params_q)
+            kv_bytes = 2 * cfg.num_layers * ctx_len * cfg.num_kv_heads * cfg.head_dim * 2 * b8
+            gbps = (qbytes + kv_bytes) / step_s / 1e9
+            point = {
+                "batch": b8, "ctx": ctx_len, "weight_dtype": "int8",
+                "step_ms": round(step_s * 1000, 3),
+                "tok_s_per_user": round(1.0 / step_s, 2),
+                "tok_s_per_chip": round(b8 / step_s, 1),
+                "achieved_hbm_gbps": round(gbps, 1),
+                "pct_hbm_roofline": round(100 * gbps / hbm_gbps, 1) if hbm_gbps else None,
+            }
+            decode_points.append(point)
+            _emit_partial("decode_point", point)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"decode int8w: {type(e).__name__}: {e}")
+        finally:
+            # Free on every path: leaked int8 copies push the 8B section
+            # over HBM (its own failure-mode comment).
+            del params_q
 
     # --- prefill ------------------------------------------------------------
     prefill_detail = None
