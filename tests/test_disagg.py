@@ -198,7 +198,9 @@ async def test_prefill_first_no_workers_falls_back_local():
         t0 = time.monotonic()
         out, fin = await collect(handler, req(list(range(40))))
         assert len(out) == 6 and fin == "length"
-        assert time.monotonic() - t0 < 5.0  # no 30s queue timeout paid
+        # Guard against paying the 30s queue timeout; generous margin for
+        # first-jit compiles on a loaded single-core box (flaked at 5s).
+        assert time.monotonic() - t0 < 15.0
         assert handler.remote_prefills == 0 and handler.local_prefills == 1
         await decode_engine.stop()
     finally:

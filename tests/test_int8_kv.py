@@ -134,6 +134,7 @@ def test_transfer_roundtrip_and_kvbm_with_int8():
     alloc.register_hashes(blocks, hashes)
     alloc.release(blocks)
     got = alloc.allocate(5)  # exhaust the pool: both cached blocks evict → G2
+    kvbm.flush_pending()  # async offload: host transfer batches at drain
     assert kvbm.metrics.offloads_g2 == 2
     alloc.release(got)
     match = kvbm.match_prefix(hashes)
