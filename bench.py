@@ -291,9 +291,17 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
         engine = TpuEngine.build(
             EngineArgs(
                 model="tiny",
-                scheduler=SchedulerConfig(num_blocks=1024, max_running=32,
+                scheduler=SchedulerConfig(num_blocks=1024, max_running=64,
                                           prefill_buckets=[32, 64, 128],
-                                          decode_buckets=[1, 2, 4, 8, 16, 32],
+                                          # max_running/top bucket cover the
+                                          # sweep's top concurrency: with 32
+                                          # slots the conc-64 level queued
+                                          # half its requests a full request
+                                          # duration (r05: TTFT p50 242 ms);
+                                          # mixed steps + wave admission keep
+                                          # the wider batch fed without
+                                          # prefill stalls.
+                                          decode_buckets=[1, 2, 4, 8, 16, 32, 64],
                                           # Single-step: windows amortize
                                           # DISPATCH cost, which a local CPU
                                           # engine doesn't pay — a 32-step
@@ -302,7 +310,12 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
                                           # (measured: 6.1 -> 5.4 req/s).
                                           num_scheduler_steps=1),
                 # Precompile: the serving measurement must not time XLA.
-                warmup_ctx=64,
+                # 160 covers the sweep's real contexts (~70-token templated
+                # prompt + 16 out → width rung 6): at 64 the width-6 decode
+                # executables compiled mid-traffic and the first high-
+                # concurrency level timed XLA, not serving (measured: first
+                # b64 level p50 252 ms, second 90 ms).
+                warmup_ctx=160,
             )
         )
         manager = ModelManager()
@@ -370,12 +383,101 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
                     continue
                 sweep.append(await level(session, conc, max(n_requests, 3 * conc)))
 
+        sched = engine.scheduler
+        mixed = {
+            "steps": sched.mixed_steps_total,
+            "prefill_tokens": sched.mixed_prefill_tokens_total,
+            "decode_tokens": sched.mixed_decode_tokens_total,
+        }
         await svc.stop()
         await engine.stop()
         best = max(sweep, key=lambda p: p["req_s"])
-        return {**best, "sweep": sweep}
+        return {**best, "sweep": sweep, "mixed": mixed}
 
     return asyncio.run(run())
+
+
+def bench_mixed_admission():
+    """Mixed prefill+decode steps, measured at the scheduler (no HTTP): a
+    long prompt arrives while a decode wave runs. Phase-separated
+    scheduling dispatches the whole prompt as one stall between decode
+    steps; mixed steps carry mixed_prefill_budget-token chunks inside the
+    decode dispatch. Reports the decode wave's worst inter-token gap and
+    the newcomers' TTFT, mixed on vs off, plus the per-step composition
+    counters the scheduler now exports."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+
+    cfg = get_config("tiny").replace(max_seq_len=4096)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def run(mixed: bool) -> dict:
+        sched = Scheduler(cfg, params, SchedulerConfig(
+            num_blocks=768, max_running=16,
+            prefill_buckets=[32, 64, 128, 256, 512, 1024],
+            decode_buckets=[1, 2, 4, 8, 16],
+            num_scheduler_steps=1, enable_prefix_caching=False,
+            enable_mixed_batching=mixed,
+        ), dtype=jnp.float32)
+        for i in range(8):
+            sched.add_request(f"d{i}", list(range(1, 33)),
+                              SamplingParams(temperature=0.0), StopConditions(max_tokens=400))
+        for _ in range(12):  # decode wave warm + executables compiled
+            sched.step()
+        # Warm the long-prompt shapes too so the gap measures scheduling,
+        # not XLA compiles, for both modes.
+        sched.add_request("warm", list(range(3, 1027)),
+                          SamplingParams(temperature=0.0), StopConditions(max_tokens=2))
+        for _ in range(40):
+            sched.step()
+
+        t0 = time.perf_counter()
+        sched.add_request("long", list(range(5, 1029)),
+                          SamplingParams(temperature=0.0), StopConditions(max_tokens=4))
+        sched.add_request("short", list(range(7, 39)),
+                          SamplingParams(temperature=0.0), StopConditions(max_tokens=4))
+        long_ttft = short_ttft = None
+        last_decode = t0
+        max_gap = 0.0
+        for _ in range(400):
+            outs = sched.step()
+            now = time.perf_counter()
+            if any(s.request_id.startswith("d") and o.token_id >= 0 for s, o in outs):
+                max_gap = max(max_gap, now - last_decode)
+                last_decode = now
+            for s, o in outs:
+                if o.token_id >= 0 and s.request_id == "long" and long_ttft is None:
+                    long_ttft = now - t0
+                if o.token_id >= 0 and s.request_id == "short" and short_ttft is None:
+                    short_ttft = now - t0
+            if long_ttft is not None and short_ttft is not None:
+                break
+        return {
+            "enable_mixed_batching": mixed,
+            "long_ttft_ms": round(long_ttft * 1000, 2) if long_ttft else None,
+            "short_ttft_ms": round(short_ttft * 1000, 2) if short_ttft else None,
+            "decode_max_gap_ms": round(max_gap * 1000, 2),
+            "mixed_steps": sched.mixed_steps_total,
+            "mixed_prefill_tokens": sched.mixed_prefill_tokens_total,
+            "mixed_decode_tokens": sched.mixed_decode_tokens_total,
+        }
+
+    on = run(True)
+    off = run(False)
+    return {
+        "mixed_on": on,
+        "mixed_off": off,
+        "isl": 1024,
+        "decode_stall_ratio": round(off["decode_max_gap_ms"] / max(on["decode_max_gap_ms"], 1e-3), 2),
+        "note": "tiny model on CPU — scheduling structure, not device speed; "
+                "decode_max_gap is the worst stall a 1K prefill injects into "
+                "an active 8-wide decode wave",
+    }
 
 
 # --------------------------------------------------------------------------
@@ -392,6 +494,10 @@ def _run_cpu_subprocess(argv, key, timeout_s, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("BENCH_CHILD", None)
+    # Helpers under tools/ put THEIR dir on sys.path, not the repo root —
+    # make dynamo_tpu importable even without a pip install.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env.update(extra_env or {})
     out = subprocess.run(argv, env=env, capture_output=True, text=True, timeout=timeout_s)
     for line in out.stdout.splitlines():
@@ -687,12 +793,33 @@ def child_main() -> None:
         errors.append("http_e2e skipped: budget")
 
 
+    # --- mixed prefill+decode admission (scheduler-level, CPU subprocess) ---
+    mixed_admission = None
+    if remaining() > 60:
+        try:
+            mixed_admission, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "mixed_on",
+                max(60, remaining() - 10), extra_env={"BENCH_MIXED_ONLY": "1"},
+            )
+            if mixed_admission is None:
+                errors.append(f"mixed_admission: {err}")
+            else:
+                _emit_partial("mixed_admission", mixed_admission)
+        except subprocess.TimeoutExpired:
+            errors.append("mixed_admission: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"mixed_admission: {type(e).__name__}: {e}")
+    else:
+        errors.append("mixed_admission skipped: budget")
+
+
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
                               cpu_fallback, errors, tpu_http=tpu_http,
-                              router_prefix=router_prefix, large_model=large_detail)), flush=True)
+                              router_prefix=router_prefix, large_model=large_detail,
+                              mixed_admission=mixed_admission)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -717,6 +844,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "http_e2e": http,
             "router_prefix": router_prefix,
             "large_model": large_model,
+            "mixed_admission": mixed_admission,
             "device": device,
             "cpu_fallback": cpu_fallback,
             "errors": errors,
@@ -834,6 +962,7 @@ def main() -> None:
             cpu_fallback, [], tpu_http=partials.get("tpu_http_e2e"),
             router_prefix=partials.get("router_prefix"),
             large_model=partials.get("large_model"),
+            mixed_admission=partials.get("mixed_admission"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
     final["detail"]["wall_s"] = round(time.time() - t_start, 1)
@@ -841,7 +970,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_HTTP_ONLY") == "1":
+    if os.environ.get("BENCH_MIXED_ONLY") == "1":
+        # CPU-pinned like the http section: the subject is scheduler
+        # structure (mixed vs phase-separated steps), not the device.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_mixed_admission()), flush=True)
+    elif os.environ.get("BENCH_HTTP_ONLY") == "1":
         # Force the CPU backend from inside the process: the axon TPU plugin
         # can override the JAX_PLATFORMS env var (observed), and this section
         # must measure the serving plane, not the device tunnel.

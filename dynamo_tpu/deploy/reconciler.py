@@ -10,7 +10,12 @@ so tests run the full create→scale→delete→status loop against a stub.
 
 Reconcile semantics per DGD:
 - missing Deployment            → ``kubectl apply`` the rendered manifest
-- replica/spec drift            → apply again (server-side merge)
+- rendered-manifest drift       → apply again (server-side merge). Drift is
+  detected by a hash of the FULL rendered manifest carried in an
+  annotation — image, env, resource, and command changes all re-apply,
+  not just ``spec.replicas`` — plus a live-replicas check so out-of-band
+  ``kubectl scale`` is reverted even though it leaves the annotation
+  intact
 - Deployment labeled for this graph but absent from its spec → delete
 - status merge-patched onto the CR: per-service desired/ready counts and
   a Ready condition (the reference writes status conditions the same way)
@@ -23,6 +28,7 @@ even without ownerReference GC.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import shutil
 from typing import Dict, List, Optional
@@ -34,6 +40,13 @@ from dynamo_tpu.runtime.logging import get_logger
 logger = get_logger(__name__)
 
 MANAGED_BY = "dynamo-tpu-operator"
+HASH_ANNOTATION = "dynamo-tpu/manifest-hash"
+
+
+def manifest_hash(man: dict) -> str:
+    """Stable digest of a rendered manifest (computed BEFORE the hash
+    annotation itself is attached)."""
+    return hashlib.sha256(json.dumps(man, sort_keys=True).encode()).hexdigest()[:16]
 
 
 class KubeReconciler:
@@ -94,15 +107,23 @@ class KubeReconciler:
                 man["metadata"]["labels"]["dynamo-graph"] = graph.name
                 name = man["metadata"]["name"]
                 claimed.add(name)
+                want_hash = manifest_hash(man)
+                man["metadata"].setdefault("annotations", {})[HASH_ANNOTATION] = want_hash
                 existing = by_name.get(name)
                 want = man["spec"]["replicas"]
                 if existing is None:
                     await self._run("apply", "-f", "-", stdin=json.dumps(man))
                     logger.info("created deployment %s (graph %s)", name, graph.name)
                     ready = 0
-                elif existing["spec"].get("replicas") != want:
+                elif (
+                    existing["metadata"].get("annotations", {}).get(HASH_ANNOTATION)
+                    != want_hash
+                    or existing["spec"].get("replicas") != want
+                ):
+                    # Any rendered drift (image/env/resources/command, not
+                    # just replicas) OR live replica drift re-applies.
                     await self._run("apply", "-f", "-", stdin=json.dumps(man))
-                    logger.info("scaled deployment %s -> %d", name, want)
+                    logger.info("re-applied drifted deployment %s", name)
                     ready = int(existing.get("status", {}).get("readyReplicas") or 0)
                 else:
                     ready = int(existing.get("status", {}).get("readyReplicas") or 0)

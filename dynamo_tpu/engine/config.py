@@ -62,7 +62,12 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
-    # Decode attention implementation for the cached-prefix piece.
+    # Decode attention implementation for the cached-prefix piece — in
+    # plain decode steps AND the decode rows of MIXED prefill+decode steps
+    # (scheduler mixed batching: each engine step carries the full decode
+    # batch plus up to SchedulerConfig.mixed_prefill_budget prefill-chunk
+    # tokens as one ragged batch — per-sequence (start, len) rows over the
+    # paged cache, decode entries are length-1 rows; llama.mixed_step).
     # "auto" == "gather": XLA width-bucketed gather, two-piece online-
     # softmax merge, once-per-window hoist (decode_multi). "paged" opts in
     # to the Pallas paged flash-decode kernel (attention/decode.py) —
@@ -71,14 +76,18 @@ class ModelConfig:
     # dispatch overhead (a no-op kernel inside a jitted loop measures
     # 1.3-5 ms/call; 16 per-layer calls/step is fatal), so the kernel
     # loses to the gather end-to-end regardless of its memory-traffic win.
-    # The r4 kernel was deleted for a different reason (per-page DMA issue
-    # cost at 16-token pages); both records matter if this is revisited on
-    # a direct-attached TPU.
+    # Opt in only on a direct-attached TPU at long contexts, where the
+    # once-per-page HBM read beats the gather's triple traffic and the
+    # dispatch tax is gone. The r4 kernel was deleted for a different
+    # reason (per-page DMA issue cost at 16-token pages); both records
+    # matter if this is revisited.
     attention_impl: str = "auto"
-    # Prefill chunk attention: "auto" = Pallas flash kernel on TPU
-    # (attention/prefill.py — 40.8 TFLOP/s causal vs ~2 for the two-piece
-    # XLA path at 1B shapes on v5e), XLA path elsewhere; "flash"/"xla"
-    # force one ("flash" off-TPU runs the kernel interpreted — tests only).
+    # Prefill chunk attention — for phase-separated prefills AND the
+    # ragged chunk rows of mixed steps (attention/ragged.py): "auto" =
+    # Pallas flash kernel on TPU (attention/prefill.py — 40.8 TFLOP/s
+    # causal vs ~2 for the two-piece XLA path at 1B shapes on v5e), XLA
+    # path elsewhere; "flash"/"xla" force one ("flash" off-TPU runs the
+    # kernel interpreted — tests only).
     prefill_impl: str = "auto"
     # KV cache storage dtype: "auto" follows the compute dtype; "int8" stores
     # quantized KV (per-token-per-head symmetric scale) — halves KV memory,

@@ -360,4 +360,14 @@ class TpuEngine:
 
     def stats_handler(self) -> dict:
         m = self.scheduler.metrics()
-        return {"kv_usage": m.kv_usage, "num_running": m.num_running, "num_waiting": m.num_waiting}
+        return {
+            "kv_usage": m.kv_usage,
+            "num_running": m.num_running,
+            "num_waiting": m.num_waiting,
+            # Mixed-step composition (scrape-visible so the planner and
+            # dashboards can see how much prefill rides the decode wave —
+            # runtime/metrics.py documents the derived gauges).
+            "mixed_steps_total": m.mixed_steps_total,
+            "mixed_prefill_tokens_total": m.mixed_prefill_tokens_total,
+            "mixed_decode_tokens_total": m.mixed_decode_tokens_total,
+        }

@@ -69,6 +69,22 @@ def dequantize_kv(x, dtype=jnp.bfloat16):
     return x
 
 
+def ragged_scatter_targets(
+    block_table: jax.Array,  # [W] block ids for one sequence (0 = scratch)
+    positions: jax.Array,  # [T] absolute write slot per token row
+    live: jax.Array,  # [T] bool — dead rows (bucket padding) sink to block 0
+    block_size: int,
+):
+    """Paged-KV scatter targets for a ragged run of token rows sharing one
+    block table (a prefill chunk, or one sequence's slice of a mixed
+    batch). Returns ``(tgt_blocks [T], tgt_offs [T])``; dead rows target
+    the reserved scratch block 0 so no real block is corrupted. Shared by
+    ``llama.prefill`` and ``llama.mixed_step`` so the per-row position →
+    (block, offset) convention lives in one place."""
+    slots = jnp.where(live, positions, 0)
+    return jnp.where(live, block_table[slots // block_size], 0), slots % block_size
+
+
 @dataclass
 class KvCacheArrays:
     """Device-side block pool (one array pair covering all layers). With

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.engine.config import ModelConfig
-from dynamo_tpu.engine.kv_cache import QuantKv, quantize_kv_rows
+from dynamo_tpu.engine.kv_cache import QuantKv, quantize_kv_rows, ragged_scatter_targets
 from dynamo_tpu.engine.quant import dequant_layer
 
 Params = Dict[str, jax.Array]
@@ -441,9 +441,7 @@ def prefill(
         h = jnp.where(inject[:, None], rows.astype(h.dtype), h)
 
     # Scatter targets for the new tokens; padded positions sink to block 0.
-    slots = jnp.where(valid_q, positions, 0)
-    tgt_blocks = jnp.where(valid_q, block_table[slots // bs], 0)  # [T]
-    tgt_offs = slots % bs
+    tgt_blocks, tgt_offs = ragged_scatter_targets(block_table, positions, valid_q, bs)
 
     # The cache is READ-ONLY inside the layer scan (slices ride the scan xs);
     # each layer's fresh chunk K/V is attended in-register and stacked into
@@ -451,17 +449,8 @@ def prefill(
     # scatter inside the carry forced XLA into a full cache copy per layer
     # (~5 ms/step at 1B/b8 on v5e — measured); this formulation keeps the
     # cache bytes touched proportional to the tokens written.
-    # Prefix mask: cached key j visible iff j < cache_len. Chunk-internal
-    # attention is causal within the chunk.
-    key_pos = jnp.arange(ctx, dtype=jnp.int32)
-    chunk_q = jnp.arange(T, dtype=jnp.int32)
-    if not use_flash:
-        prefix_mask = jnp.broadcast_to(key_pos[None, :] < cache_len, (T, ctx))  # [T, ctx]
-        chunk_mask = (chunk_q[None, :] <= chunk_q[:, None]) & valid_q[None, :]  # [T, T]
-        mask = jnp.concatenate([prefix_mask, chunk_mask], axis=1)  # [T, ctx+T]
     interp = jax.default_backend() != "tpu"
-    scale = c.head_dim**-0.5
-    kvh, G = c.num_kv_heads, c.num_heads // c.num_kv_heads
+    kvh = c.num_kv_heads
 
     # Layer-flat cache view: gathering from [L*N, ...] with layer-offset
     # tables avoids the scan's per-layer dynamic-slice of the cache, which
@@ -484,46 +473,23 @@ def prefill(
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
-        if use_flash:
-            from dynamo_tpu.engine.attention.prefill import (
-                flash_chunk_attention,
-                merge_attention_pieces,
-            )
+        # Ragged chunk attention over [cached prefix ; chunk] — shared with
+        # the mixed prefill+decode step (attention/ragged.py). The prefix
+        # gather is bounded by the caller's width-bucketed table — the true
+        # prefix extent, not max_seq_len; flash fresh chunks skip it.
+        from dynamo_tpu.engine.attention.ragged import ragged_chunk_attention
 
-            out2, m2, l2 = flash_chunk_attention(
-                q, k, v, valid_len, num_kv_heads=kvh, interpret=interp
-            )
-            if has_prefix:
-                # Cached-prefix partial (online-softmax state), merged with
-                # the kernel's chunk piece. The gather is bounded by the
-                # caller's width-bucketed table — the true prefix extent,
-                # not max_seq_len.
-                table_l = block_table + l * N
-                k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
-                v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
-                qg = q.reshape(T, kvh, G, c.head_dim)
-                s = jnp.einsum("tkgd,skd->ktgs", qg, k_ctx).astype(jnp.float32) * scale
-                s = jnp.where((key_pos < cache_len)[None, None, None, :], s, -1e30)
-                m1 = jnp.max(s, axis=-1)  # [KVH, T, G]
-                p = jnp.exp(s - m1[..., None])
-                l1 = jnp.sum(p, axis=-1)
-                acc1 = jnp.einsum("ktgs,skd->ktgd", p.astype(v_ctx.dtype), v_ctx).astype(
-                    jnp.float32
-                )
-                attn = merge_attention_pieces(out2, m2, l2, m1, l1, acc1)
-            else:
-                attn = out2
+        if use_flash and not has_prefix:
+            k_ctx = v_ctx = None
         else:
             table_l = block_table + l * N
-            k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
-            v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, c.num_kv_heads, c.head_dim)
-            attn = _attend(
-                q,
-                jnp.concatenate([k_ctx, k], axis=0),
-                jnp.concatenate([v_ctx, v], axis=0),
-                mask,
-                c,
-            )
+            k_ctx = _gather_kv(k_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
+            v_ctx = _gather_kv(v_flat, table_l, h.dtype).reshape(ctx, kvh, c.head_dim)
+        attn = ragged_chunk_attention(
+            q, k, v, k_ctx, v_ctx, valid_len, cache_len,
+            num_kv_heads=kvh, use_flash=use_flash, has_prefix=has_prefix,
+            interpret=interp,
+        )
         h = h + attn.reshape(T, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
@@ -960,6 +926,152 @@ def chunk_decode(
     if moe_stats:
         return next_tokens, k_new, v_new, chunk_aux
     return next_tokens, k_new, v_new
+
+
+def mixed_step(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    p_tokens: jax.Array,  # [S] prefill-chunk token ids (bucket-padded)
+    p_valid: jax.Array,  # scalar i32: actual chunk tokens (the row's ``len``)
+    p_cache_len: jax.Array,  # scalar i32: tokens already materialized (``start``)
+    p_table: jax.Array,  # [Wp] the chunk sequence's block table (width-bucketed)
+    d_tokens: jax.Array,  # [B] current token per decode row
+    d_positions: jax.Array,  # [B] write slot of each decode token
+    d_tables: jax.Array,  # [B, Wd] decode block tables
+    d_active: jax.Array,  # [B] bool — padded decode lanes are False
+    use_flash: bool = False,  # static: Pallas flash kernel for the chunk piece
+    has_prefix: bool = True,  # static on flash: False ⇒ p_cache_len == 0
+    moe_stats: bool = False,  # static: also return {"moe_dropped", "moe_assignments"}
+) -> Tuple[jax.Array, ...]:
+    """One MIXED engine step: a ragged prefill chunk + the full decode batch
+    in ONE compiled dispatch. Returns ``(logits [1+B, V] f32, k_cache,
+    v_cache)`` — row 0 is the chunk's last-valid position (the prompt's
+    next-token logits once the chunk completes it), rows 1.. are the decode
+    rows. Sampling happens only at each sequence's last row: decode entries
+    are their own last row; the chunk contributes exactly one.
+
+    This dissolves the prefill/decode phase boundary: the flat token axis
+    is ``[chunk row (start=p_cache_len, len=p_valid) ; B length-1 decode
+    rows]``. Projections, MLP, and the final fused KV scatter run over the
+    whole ragged batch (decode matmuls alone leave the MXU idle — the chunk
+    tokens ride the same dispatch instead of stalling behind it), while
+    attention splits into the two shapes it actually has: the ragged chunk
+    piece (attention/ragged.py — width-bucketed prefix gather + causal
+    chunk, flash kernel opt-in) and the decode rows' two-piece online-
+    softmax (cached prefix + current token in-register), identical math to
+    ``prefill`` and ``decode`` respectively."""
+    c = config
+    bs = c.block_size
+    S = p_tokens.shape[0]
+    B = d_tokens.shape[0]
+    L, KVH, HD = c.num_layers, c.num_kv_heads, c.head_dim
+    kvh, G, hd = KVH, c.num_heads // KVH, HD
+    scale = hd**-0.5
+    interp = jax.default_backend() != "tpu"
+
+    N = k_cache.shape[1]
+    k_flat = k_cache.reshape(L * N, bs, kvh, hd)
+    v_flat = v_cache.reshape(L * N, bs, kvh, hd)
+
+    p_positions = p_cache_len + jnp.arange(S, dtype=jnp.int32)
+    p_valid_q = jnp.arange(S, dtype=jnp.int32) < p_valid
+    positions_all = jnp.concatenate([p_positions, d_positions])
+    valid_all = jnp.concatenate([p_valid_q, d_active])
+    h = params["embed"].at[jnp.concatenate([p_tokens, d_tokens])].get(mode="clip")  # [S+B, D]
+
+    ctx_p = p_table.shape[0] * bs
+    ctx_d = d_tables.shape[1] * bs
+    d_tgt_blocks, d_tgt_offs, d_mask = decode_targets(d_positions, d_tables, d_active, bs)
+    use_paged = _use_paged_decode(c, k_cache)
+    d_prefix_lens = jnp.minimum(d_positions, ctx_d).astype(jnp.int32)
+
+    from dynamo_tpu.engine.attention.ragged import ragged_chunk_attention
+
+    def layer_fn(h, xs):
+        lp, l = xs
+        lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
+        x = rms_norm(h, lp["attn_norm"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(S + B, c.num_heads, hd)
+        k = (x @ lp["wk"]).reshape(S + B, kvh, hd)
+        v = (x @ lp["wv"]).reshape(S + B, kvh, hd)
+        q = apply_rope(q, positions_all, c.rope_theta)
+        k = apply_rope(k, positions_all, c.rope_theta)
+
+        # Chunk piece: [cached prefix ; chunk] — prefill's exact math.
+        if use_flash and not has_prefix:
+            kp_ctx = vp_ctx = None
+        else:
+            table_pl = p_table + l * N
+            kp_ctx = _gather_kv(k_flat, table_pl, h.dtype).reshape(ctx_p, kvh, hd)
+            vp_ctx = _gather_kv(v_flat, table_pl, h.dtype).reshape(ctx_p, kvh, hd)
+        attn_p = ragged_chunk_attention(
+            q[:S], k[:S], v[:S], kp_ctx, vp_ctx, p_valid, p_cache_len,
+            num_kv_heads=kvh, use_flash=use_flash, has_prefix=has_prefix,
+            interpret=interp,
+        )
+
+        # Decode rows: cached prefix + current token in-register — the
+        # decode_layer_scan two-piece merge.
+        qg_d = q[S:].reshape(B, kvh, G, hd)
+        if use_paged:
+            m1, l1, acc1 = _paged_prefix_partials(
+                c, q[S:], k_flat, v_flat, d_tables + l * N, d_prefix_lens
+            )
+        else:
+            tables_dl = d_tables + l * N
+            kd_ctx = _gather_kv(k_flat, tables_dl, h.dtype).reshape(B, ctx_d, kvh, hd)
+            vd_ctx = _gather_kv(v_flat, tables_dl, h.dtype).reshape(B, ctx_d, kvh, hd)
+            m1, l1, acc1 = _attend_piece(qg_d, kd_ctx, vd_ctx, d_mask, scale)
+        m2, l2, acc2 = _attend_piece(
+            qg_d, k[S:, None], v[S:, None], jnp.ones((B, 1), dtype=bool), scale
+        )
+        attn_d = _merge_pieces(m1, l1, acc1, m2, l2, acc2).astype(h.dtype)
+
+        attn = jnp.concatenate(
+            [attn_p.reshape(S, c.q_size), attn_d.reshape(B, c.q_size)], axis=0
+        )
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+        if moe_stats:
+            mlp_out, drops = _mlp(x, lp, c, valid=valid_all, stats=True)
+            return h + mlp_out, (k, v, drops)
+        h = h + _mlp(x, lp, c, valid=valid_all)
+        return h, (k, v)
+
+    if moe_stats:
+        h, (k_rows, v_rows, layer_drops) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
+        aux = {
+            "moe_dropped": jnp.sum(layer_drops),
+            "moe_assignments": jnp.sum(valid_all).astype(jnp.int32)
+            * jnp.int32(max(c.num_experts_per_tok, 1) * L),
+        }
+    else:
+        h, (k_rows, v_rows) = lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L, dtype=jnp.int32))
+        )
+
+    # ONE fused ragged scatter for chunk rows + decode rows together.
+    p_tgt_blocks, p_tgt_offs = ragged_scatter_targets(p_table, p_positions, p_valid_q, bs)
+    tgt_blocks = jnp.concatenate([p_tgt_blocks, d_tgt_blocks])
+    tgt_offs = jnp.concatenate([p_tgt_offs, d_tgt_offs])
+    layer_idx = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, S + B))
+    k_new = _scatter_kv(k_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], k_rows)
+    v_new = _scatter_kv(v_cache, layer_idx, tgt_blocks[None, :], tgt_offs[None, :], v_rows)
+
+    # lm_head only at each sequence's LAST row: the chunk's last valid
+    # position + every decode row — [1+B, D] picked rows, never [S+B, V].
+    last_p = jnp.maximum(p_valid - 1, 0)
+    h_rows = jnp.concatenate([h[last_p][None], h[S:]], axis=0)
+    h_rows = rms_norm(h_rows, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = (h_rows @ (head if head is not None else params["embed"].T)).astype(jnp.float32)
+    if moe_stats:
+        return logits, k_new, v_new, aux
+    return logits, k_new, v_new
 
 
 def embed(

@@ -57,9 +57,16 @@ def quantize_weight_np(w) -> QuantW:
 
 
 def wt(x, dtype=jnp.bfloat16):
-    """Dequantize a QuantW to the compute dtype; plain arrays pass through."""
+    """Dequantize a QuantW to the compute dtype; plain arrays pass through.
+
+    The product runs in f32 (codes are exact in f32, scale is stored f32)
+    and only the RESULT casts down: multiplying in bf16 first rounds the
+    scale to 8 mantissa bits and compounds a second rounding on the
+    product — ~0.4% worst-case extra error per weight, on top of the
+    half-code-step quantization floor. XLA still fuses the dequant into
+    the consuming matmul's reads either way."""
     if isinstance(x, QuantW):
-        return (x.q.astype(dtype) * x.scale.astype(dtype)).astype(dtype)
+        return (x.q.astype(jnp.float32) * x.scale).astype(dtype)
     return x
 
 

@@ -45,6 +45,26 @@ class SamplingParams:
         return self.frequency_penalty != 0.0 or self.presence_penalty != 0.0
 
 
+def pack_param_rows(samplings: List["SamplingParams"], bucket: int):
+    """Pack per-request sampling params into the sampler's per-slot numpy
+    rows, padded to ``bucket``. Pad rows are greedy (temperature 0.0,
+    top_p 1.0) so all-greedy batches hit the sampler's argmax fast path
+    regardless of bucket padding. One packing rule for every batched
+    sampler call site: single-step decode, multi-step windows, spec-decode
+    rounds, wave admission, and mixed prefill+decode steps — a mixed step
+    samples only at each sequence's last row, and these rows ARE those."""
+    import numpy as np
+
+    temps = np.zeros((bucket,), dtype=np.float32)
+    top_ks = np.zeros((bucket,), dtype=np.int32)
+    top_ps = np.ones((bucket,), dtype=np.float32)
+    for i, s in enumerate(samplings):
+        temps[i] = s.temperature
+        top_ks[i] = s.top_k
+        top_ps[i] = s.top_p
+    return temps, top_ks, top_ps
+
+
 # Top-k/top-p thresholds are resolved inside the best-SAMPLE_WINDOW logits
 # (lax.top_k) instead of a full-vocab sort: two O(V log V) sorts per step
 # cost ~7 ms on a 128k vocab (v5e, b8) — more than the whole 1B forward

@@ -13,8 +13,14 @@ implemented for real against XLA's static-shape world:
 - **Prefix caching**: prompt block hashes are matched against the allocator's
   registry; matched blocks skip prefill entirely (the engine-side half of the
   KV-aware routing story, §3D).
+- **Mixed prefill+decode steps**: with sequences decoding AND prefill work
+  waiting, each iteration dispatches ONE ragged batch — the full decode
+  batch plus up to ``mixed_prefill_budget`` chunk tokens (llama.mixed_step;
+  DynaServe arXiv:2504.09285 / TPU ragged paged attention arXiv:2604.15464
+  show the same unification). A long prefill no longer stalls the decode
+  wave, and admission no longer waits for an empty one.
 - **Priority**: decode-first each iteration (keeps ITL low), one prefill
-  admission per iteration (bounds TTFT).
+  chunk per iteration (bounds TTFT).
 
 The step loop runs in a worker thread (`asyncio.to_thread`) so device-blocked
 steps never stall the process's asyncio IO (the serving plane).
@@ -181,11 +187,24 @@ class SchedulerConfig:
     # While requests wait for admission, cap decode windows at this rung
     # (None = keep full windows). Full windows maximize throughput on
     # dispatch-latency-heavy links — each window pays one ~100 ms host
-    # round-trip on tunneled devices, so shrinking windows under load
-    # serialized tokens on the wire (measured: served rate fell 25%). A
-    # latency-sensitive deployment can set 8 to bound admission delay at
-    # ~8 step times.
-    window_waiting_cap: Optional[int] = None
+    # round-trip on tunneled devices, so shrinking FURTHER under load
+    # serialized tokens on the wire (measured: served rate fell 25% at
+    # cap 1). Default 8: a newly arrived request must never wait a full
+    # 32-step window for admission (TTFT regression flagged in ADVICE.md)
+    # — mixed batching largely subsumes this (prefill rides the decode
+    # step), but the cap still bounds the window on the fallback paths
+    # (spec decode, non-llama, mixed disabled). None restores full windows.
+    window_waiting_cap: Optional[int] = 8
+    # Mixed prefill+decode steps: when sequences are decoding AND prefill
+    # work is waiting, each engine step carries the full decode batch plus
+    # up to ``mixed_prefill_budget`` prefill tokens from the head of the
+    # queue in ONE dispatch (llama.mixed_step) — a long prefill no longer
+    # stalls the decode wave, and admission no longer waits for an empty
+    # one. The budget bounds the chunk riding each step (the per-step
+    # decode stall is one chunk's compute, not a whole prompt's); an
+    # itl_budget_ms cap composes on top via _chunk_budget.
+    enable_mixed_batching: bool = True
+    mixed_prefill_budget: int = 512
     # ITL protection: while sequences are decoding, cap each prefill chunk so
     # its estimated device time stays under this budget (the prefill token
     # rate is learned online from measured chunks). None ⇒ chunks use
@@ -218,6 +237,13 @@ class ForwardPassMetrics:
     # by capacity limits / total routed assignments (capacity MoE only).
     moe_dropped_total: int = 0
     moe_assignments_total: int = 0
+    # Mixed-step composition: how many engine steps fused a prefill chunk
+    # into the decode dispatch, and the token split they carried. The ratio
+    # prefill_tokens/steps is the average chunk riding each decode step —
+    # the saturation signal for mixed_prefill_budget tuning.
+    mixed_steps_total: int = 0
+    mixed_prefill_tokens_total: int = 0
+    mixed_decode_tokens_total: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -308,8 +334,8 @@ class Scheduler:
             and model_config.num_experts > 0
             and model_config.moe_dispatch == "capacity"
         )
-        self.moe_dropped_total = 0
-        self.moe_assignments_total = 0
+        self._moe_dropped_total = 0
+        self._moe_assignments_total = 0
         self._pending_aux: list = []
         # llama-only kwargs (MLA's forward has its own signature).
         stats_kw = {"moe_stats": True} if self._moe_stats else {}
@@ -351,6 +377,12 @@ class Scheduler:
         # Batched admission (chunk_decode waves) — llama-family only.
         self._supports_chunk_admit = hasattr(model, "chunk_decode")
         self._admit_jits: Dict = {}
+        # Mixed prefill+decode steps (llama.mixed_step) — llama-family only.
+        self._supports_mixed = hasattr(model, "mixed_step")
+        self._mixed_jits: Dict = {}
+        self.mixed_steps_total = 0
+        self.mixed_prefill_tokens_total = 0
+        self.mixed_decode_tokens_total = 0
         if self._supports_multi_step:
             # One executable per window rung: short requests must not pay a
             # full num_scheduler_steps window (a 16-token request under a
@@ -495,6 +527,20 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    @property
+    def moe_dropped_total(self) -> int:
+        """Capacity-MoE drop counter, drained-on-read: jitted steps stage
+        their aux scalars in ``_pending_aux`` (forcing them per step would
+        add a host sync — see _consume_aux), so a direct read must drain
+        first or it sees counters up to 256 steps stale."""
+        self._drain_aux()
+        return self._moe_dropped_total
+
+    @property
+    def moe_assignments_total(self) -> int:
+        self._drain_aux()
+        return self._moe_assignments_total
+
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
         self._drain_aux()
@@ -507,20 +553,186 @@ class Scheduler:
             prefill_tokens_in_flight=sum(len(s.prompt) - s.num_computed for s in self.waiting),
             request_total=self.request_total,
             spec_decode=self.spec_stats.to_dict() if self.spec_stats else None,
-            moe_dropped_total=self.moe_dropped_total,
-            moe_assignments_total=self.moe_assignments_total,
+            moe_dropped_total=self._moe_dropped_total,
+            moe_assignments_total=self._moe_assignments_total,
+            mixed_steps_total=self.mixed_steps_total,
+            mixed_prefill_tokens_total=self.mixed_prefill_tokens_total,
+            mixed_decode_tokens_total=self.mixed_decode_tokens_total,
         )
 
     # --- step loop core (runs in worker thread) -----------------------------
     def step(self) -> List[tuple]:
-        """One scheduler iteration. Returns [(seq, StepOutput), ...]."""
+        """One scheduler iteration. Returns [(seq, StepOutput), ...].
+
+        With sequences decoding AND prefill work at the head of the queue,
+        the iteration is a MIXED step: one dispatch carries the decode
+        batch plus up to mixed_prefill_budget prefill tokens, so neither
+        phase stalls the other. Otherwise the phase-separated order runs:
+        decode first (ITL), then admit one prefill (TTFT)."""
         outputs: List[tuple] = []
         self._reap_aborted(outputs)
-        # Decode first (ITL), then admit one prefill (TTFT).
+        cand = self._mixed_candidate()
+        if cand is not None and not self._wave_preferred() and self._mixed_step(cand, outputs):
+            return outputs
         if self.running:
             outputs.extend(self._decode_step())
         self._admit(outputs)
         return outputs
+
+    def _mixed_candidate(self) -> Optional[Sequence]:
+        """Head-of-queue sequence eligible to ride a mixed step, or None.
+        Only the head is considered (FIFO — jumping an ineligible head
+        would starve it); ineligible heads (remote-prefilled injection,
+        multimodal, non-llama, draft-attached engines) fall back to the
+        phase-separated path, as does a full decode set when the head has
+        not been admitted yet."""
+        if not (
+            self.sc.enable_mixed_batching
+            and self._supports_mixed
+            and self.draft_params is None
+            and self.running
+            and self.waiting
+        ):
+            return None
+        head = self.waiting[0]
+        if head.aborted or head.prefilled is not None or head.mm_features is not None:
+            return None
+        if head.state == SeqState.WAITING and len(self.running) >= self.sc.max_running:
+            return None
+        return head
+
+    def _wave_preferred(self) -> bool:
+        """Prefer batched wave admission over a mixed step when ≥2 short
+        wave-eligible prompts wait AND the head's chunk fits the mixed
+        budget anyway — the wave admits them all in one dispatch with a
+        stall no worse than the chunk a mixed step would carry. Long-prompt
+        heads always take the mixed path: a wave would dispatch the whole
+        prompt in one stall, which is exactly the regression mixed steps
+        exist to kill."""
+        if not self._supports_chunk_admit or self.draft_params is not None:
+            return False
+        if self.sc.itl_budget_ms and self.running:
+            return False  # _admit_wave refuses under an ITL budget too
+        cap = min(self._wave_s_cap(), self.sc.mixed_prefill_budget or self._wave_s_cap())
+        room = self.sc.max_running - len(self.running)
+        if room < 2:
+            return False
+        head = self.waiting[0]
+        if not (self._wave_eligible(head) and len(head.prompt) <= cap):
+            return False
+        n = sum(
+            1 for seq in self.waiting[: self.sc.decode_buckets[-1]]
+            if self._wave_eligible(seq) and len(seq.prompt) <= cap
+        )
+        return n >= 2
+
+    def _get_mixed_jit(self, key):
+        """Mixed-step executable for (s_bucket, p_width, d_bucket, d_width)
+        — shared by _mixed_step and warmup so both compile the same thing.
+        ``hp`` follows the prefill convention: static on the flash path
+        (the kernel skips the prefix piece), traced no-op on XLA."""
+        if key not in self._mixed_jits:
+            from dynamo_tpu.engine.models import get_module
+
+            model = get_module(self.mc)
+            stats_kw = {"moe_stats": True} if self._moe_stats else {}
+            if self._use_flash_prefill:
+                self._mixed_jits[key] = jax.jit(
+                    lambda p, k, v, pt, pv, cl, ptab, dt, dpos, dtab, dact, hp: model.mixed_step(
+                        p, self.mc, k, v, pt, pv, cl, ptab, dt, dpos, dtab, dact,
+                        use_flash=True, has_prefix=hp, **stats_kw,
+                    ),
+                    donate_argnums=(1, 2),
+                    static_argnums=(11,),
+                )
+            else:
+                self._mixed_jits[key] = jax.jit(
+                    lambda p, k, v, pt, pv, cl, ptab, dt, dpos, dtab, dact, hp: model.mixed_step(
+                        p, self.mc, k, v, pt, pv, cl, ptab, dt, dpos, dtab, dact,
+                        **stats_kw,
+                    ),
+                    donate_argnums=(1, 2),
+                )
+        return self._mixed_jits[key]
+
+    def _mixed_step(self, seq: Sequence, outputs: List[tuple]) -> bool:
+        """One mixed iteration: the full decode batch plus ``seq``'s next
+        prefill chunk in ONE dispatch. Returns False (caller falls back to
+        the phase-separated path) when the chunk's blocks can't be
+        allocated. Preemption resumes ride too — their chunk recomputes KV
+        and samples nothing at the end."""
+        resuming = seq.resume_tokens is not None
+        pf_tokens = seq.resume_tokens if resuming else seq.prompt
+        if seq.state == SeqState.WAITING:
+            total_tokens = (seq.total_len if resuming else len(seq.prompt)) + 1
+            try:
+                self._first_touch(seq, pf_tokens, total_tokens)
+            except OutOfBlocksError:
+                return False
+        if seq.num_computed >= len(pf_tokens):
+            # Prefix-cache hit covered the whole chunkable range already —
+            # nothing to compute this step; let _prefill_one finish it.
+            return False
+
+        remaining = len(pf_tokens) - seq.num_computed
+        budget = self._chunk_budget()
+        if self.sc.mixed_prefill_budget:
+            budget = min(budget, self.sc.mixed_prefill_budget)
+        chunk = min(remaining, budget)
+        s_bucket = next_bucket(chunk, self.sc.prefill_buckets)
+        chunk = min(chunk, s_bucket)
+        chunk_tokens = pf_tokens[seq.num_computed : seq.num_computed + chunk]
+        p_tok = np.zeros((s_bucket,), dtype=np.int32)
+        p_tok[: len(chunk_tokens)] = chunk_tokens
+        p_table = self._prefill_table(seq)
+        has_prefix = seq.num_computed > 0
+
+        # Decode batch formation — identical to _decode_step.
+        n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
+        batch = self.running[:n]
+        d_bucket = next_bucket(n, self.sc.decode_buckets)
+        width = self._width_bucket(max(len(s.block_ids) for s in batch))
+        tokens = np.zeros((d_bucket,), dtype=np.int32)
+        positions = np.zeros((d_bucket,), dtype=np.int32)
+        tables = np.zeros((d_bucket, width), dtype=np.int32)
+        active = np.zeros((d_bucket,), dtype=bool)
+        for i, s in enumerate(batch):
+            tokens[i] = s.all_ids[-1]
+            positions[i] = s.total_len - 1
+            tables[i, : len(s.block_ids)] = s.block_ids
+            active[i] = True
+
+        res = self._get_mixed_jit((s_bucket, p_table.shape[0], d_bucket, width))(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(p_tok), jnp.int32(len(chunk_tokens)), jnp.int32(seq.num_computed),
+            p_table, jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(active), has_prefix,
+        )
+        logits, self.cache.k, self.cache.v = self._consume_aux(res)
+        self.mixed_steps_total += 1
+        self.mixed_prefill_tokens_total += len(chunk_tokens)
+        self.mixed_decode_tokens_total += n
+
+        # Decode rows first (output-order parity with the phase-separated
+        # decode-then-admit iteration), then the chunk's progress.
+        self._finish_decode_rows(batch, d_bucket, logits[1:], outputs)
+
+        seq.num_computed += len(chunk_tokens)
+        if seq.num_computed < len(pf_tokens):
+            return True  # more chunks ride later steps
+        self.waiting.remove(seq)
+        seq.state = SeqState.RUNNING
+        self.running.append(seq)
+        self._register_full_blocks(seq)
+        if resuming:
+            # KV restored through the last generated token; the final token
+            # re-enters via decode — nothing to sample or emit.
+            seq.resume_tokens = None
+        else:
+            token = self._sample_one(seq, logits[0])
+            seq.first_token_ts = time.monotonic()
+            self._append_token(seq, token, outputs)
+        return True
 
     def _reap_aborted(self, outputs: List[tuple]) -> None:
         for seq in list(self.running):
@@ -656,22 +868,19 @@ class Scheduler:
         b_bucket = next_bucket(len(admitted), self.sc.decode_buckets)
         width = self._width_bucket(max(len(seq.block_ids) for seq in admitted))
 
+        from dynamo_tpu.engine.sampling import pack_param_rows
+
         tokens = np.zeros((b_bucket, s_bucket), dtype=np.int32)
         pos0 = np.zeros((b_bucket,), dtype=np.int32)
         valid = np.zeros((b_bucket,), dtype=np.int32)
         tables = np.zeros((b_bucket, width), dtype=np.int32)
-        temps = np.zeros((b_bucket,), dtype=np.float32)
-        top_ks = np.zeros((b_bucket,), dtype=np.int32)
-        top_ps = np.ones((b_bucket,), dtype=np.float32)
+        temps, top_ks, top_ps = pack_param_rows([s.sampling for s in admitted], b_bucket)
         for i, seq in enumerate(admitted):
             chunk = seq.prompt[seq.num_computed:]
             tokens[i, : len(chunk)] = chunk
             pos0[i] = seq.num_computed
             valid[i] = len(chunk)
             tables[i, : len(seq.block_ids)] = seq.block_ids
-            temps[i] = seq.sampling.temperature
-            top_ks[i] = seq.sampling.top_k
-            top_ps[i] = seq.sampling.top_p
 
         res = self._get_admit_jit((b_bucket, s_bucket, width))(
             self.params, self.cache.k, self.cache.v,
@@ -896,7 +1105,7 @@ class Scheduler:
             p_widths = sorted(set(
                 min(r, self.max_blocks_per_seq)
                 for r in width_rungs(max(max_w, min_w))
-                if r >= min_w or r >= self.max_blocks_per_seq
+                if r >= min_w
             ))
             for width in p_widths:
                 # Both has_prefix variants: fresh prefills AND chunked/
@@ -938,6 +1147,34 @@ class Scheduler:
                     )
                 )
                 count += 1
+        # Mixed prefill+decode executables: the common (decode_bucket,
+        # prefill_bucket) shapes — the budget-sized chunk bucket (what a
+        # long prompt rides each step) at every decode bucket × width,
+        # with the minimum prefill-table width. Bucket rungs keep the key
+        # space bounded; rarer (s, Wp) keys compile lazily.
+        if (
+            self._supports_mixed
+            and self.sc.enable_mixed_batching
+            and self.draft_params is None
+        ):
+            s_b = next_bucket(
+                min(self.sc.mixed_prefill_budget or self.sc.max_prefill_chunk,
+                    self.sc.max_prefill_chunk),
+                self.sc.prefill_buckets,
+            )
+            p_w = max(16, width_bucket(1, self.max_blocks_per_seq))
+            for bucket in self.sc.decode_buckets:
+                for width in widths:
+                    res = self._get_mixed_jit((s_b, p_w, bucket, width))(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.zeros((s_b,), jnp.int32), jnp.int32(1), jnp.int32(0),
+                        jnp.zeros((p_w,), jnp.int32), jnp.zeros((bucket,), jnp.int32),
+                        jnp.zeros((bucket,), jnp.int32),
+                        jnp.zeros((bucket, width), jnp.int32),
+                        jnp.zeros((bucket,), bool), False,
+                    )
+                    _, self.cache.k, self.cache.v = self._consume_aux(res)
+                    count += 1
         return count
 
     def _draft_catchup(self, seq: Sequence, tokens: List[int], upto: int) -> None:
@@ -1017,20 +1254,12 @@ class Scheduler:
         positions = np.zeros((bucket,), dtype=np.int32)
         tables = np.zeros((bucket, width), dtype=np.int32)
         active = np.zeros((bucket,), dtype=bool)
-        # Pad rows are greedy (0.0) so all-greedy batches hit the sampler's
-        # argmax fast path regardless of bucket padding.
-        temps = np.zeros((bucket,), dtype=np.float32)
-        top_ks = np.zeros((bucket,), dtype=np.int32)
-        top_ps = np.ones((bucket,), dtype=np.float32)
 
         for i, seq in enumerate(batch):
             tokens[i] = seq.all_ids[-1]
             positions[i] = seq.total_len - 1  # write slot of the current token
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
-            temps[i] = seq.sampling.temperature
-            top_ks[i] = seq.sampling.top_k
-            top_ps[i] = seq.sampling.top_p
 
         logits, self.cache.k, self.cache.v = self._consume_aux(
             self._decode_jit(
@@ -1043,6 +1272,19 @@ class Scheduler:
                 jnp.asarray(active),
             )
         )
+        self._finish_decode_rows(batch, bucket, logits, outputs)
+        return outputs
+
+    def _finish_decode_rows(
+        self, batch: List[Sequence], bucket: int, logits: jax.Array, outputs: List[tuple]
+    ) -> None:
+        """Post-dispatch half of a single decode step: penalties, logits
+        processors, sampling (with per-request seeds), logprobs, and token
+        append/stop handling. Shared by _decode_step and _mixed_step — the
+        decode rows of a mixed dispatch carry the same per-row [B, V]
+        logits a plain decode step produces."""
+        from dynamo_tpu.engine.sampling import pack_param_rows
+
         # Frequency/presence penalties: one batched device op for the whole
         # step (per-row output-token counts via scatter-add — sampling.py).
         # Penalty-free batches skip it entirely.
@@ -1078,6 +1320,7 @@ class Scheduler:
             row_keys = make_row_keys(
                 key, jnp.asarray(seeds), jnp.asarray(poss_out), jnp.asarray(has_seed)
             )
+        temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
         sampled = np.asarray(
             self._sample_jit(
                 logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
@@ -1097,7 +1340,6 @@ class Scheduler:
                 continue  # itself preempted (no candidate to evict)
             lp = float(logprobs_np[i]) if logprobs_np is not None and seq.sampling.logprobs else None
             self._append_token(seq, int(sampled[i]), outputs, logprob=lp)
-        return outputs
 
     def _decode_multi(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
         """Multi-step decode window: N steps in one dispatch, one host sync.
@@ -1140,23 +1382,18 @@ class Scheduler:
 
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
 
+        from dynamo_tpu.engine.sampling import pack_param_rows
+
         tokens = np.zeros((bucket,), dtype=np.int32)
         positions = np.zeros((bucket,), dtype=np.int32)
         tables = np.zeros((bucket, width), dtype=np.int32)
         active = np.zeros((bucket,), dtype=bool)
-        # Pad rows are greedy (0.0) so all-greedy batches hit the sampler's
-        # argmax fast path regardless of bucket padding.
-        temps = np.zeros((bucket,), dtype=np.float32)
-        top_ks = np.zeros((bucket,), dtype=np.int32)
-        top_ps = np.ones((bucket,), dtype=np.float32)
+        temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
         for i, seq in enumerate(batch):
             tokens[i] = seq.all_ids[-1]
             positions[i] = seq.total_len - 1
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
-            temps[i] = seq.sampling.temperature
-            top_ks[i] = seq.sampling.top_k
-            top_ps[i] = seq.sampling.top_p
 
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
@@ -1204,24 +1441,21 @@ class Scheduler:
                 # the whole batch off spec forever.
                 self._draft_catchup(seq, seq.all_ids, seq.total_len - 1)
 
+        from dynamo_tpu.engine.sampling import pack_param_rows
+
         B = bucket
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
         tables = np.zeros((B, width), dtype=np.int32)
         d_toks = np.zeros((B, S), dtype=np.int32)
         d_pos0 = np.zeros((B,), dtype=np.int32)
         d_valid = np.zeros((B,), dtype=np.int32)
-        temps = np.zeros((B,), dtype=np.float32)
-        top_ks = np.zeros((B,), dtype=np.int32)
-        top_ps = np.ones((B,), dtype=np.float32)
+        temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], B)
         for i, seq in enumerate(batch):
             lag = seq.total_len - seq.d_n  # ≥ 1: the last token is never materialized
             d_toks[i, :lag] = seq.all_ids[seq.d_n :]
             d_pos0[i] = seq.d_n
             d_valid[i] = lag
             tables[i, : len(seq.block_ids)] = seq.block_ids
-            temps[i] = seq.sampling.temperature
-            top_ks[i] = seq.sampling.top_k
-            top_ps[i] = seq.sampling.top_p
         tables_j = jnp.asarray(tables)
         temps_j, tks_j, tps_j = jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps)
 
@@ -1414,8 +1648,8 @@ class Scheduler:
             return
         pend, self._pending_aux = self._pending_aux, []
         vals = jax.device_get(pend)  # one transfer for the whole batch
-        self.moe_dropped_total += int(sum(int(d) for d, _ in vals))
-        self.moe_assignments_total += int(sum(int(a) for _, a in vals))
+        self._moe_dropped_total += int(sum(int(d) for d, _ in vals))
+        self._moe_assignments_total += int(sum(int(a) for _, a in vals))
 
     def _prefill_mm_jit(self):
         """Lazy jit of the multimodal prefill variant (feature injection)."""

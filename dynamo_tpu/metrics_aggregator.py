@@ -46,7 +46,9 @@ class MetricsAggregator:
                     labels = {"worker": f"{wid:x}"}
                     for key in ("kv_usage", "num_running", "num_waiting", "in_flight",
                                 "remote_prefills", "local_prefills",
-                                "moe_dropped_total", "moe_assignments_total"):
+                                "moe_dropped_total", "moe_assignments_total",
+                                "mixed_steps_total", "mixed_prefill_tokens_total",
+                                "mixed_decode_tokens_total"):
                         if key in s:
                             self.registry.gauge(f"worker_{key}", f"worker {key}", **labels).set(float(s[key]))
                 await asyncio.sleep(self.interval_s)

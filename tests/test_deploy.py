@@ -316,3 +316,55 @@ async def test_reconciler_create_scale_delete_roundtrip(tmp_path):
     state.write_text(json.dumps(s))
     await rec.reconcile_once()
     assert json.loads(state.read_text())["deployments"] == {}
+
+
+async def test_reconciler_reapplies_image_env_drift(tmp_path):
+    """Drift detection covers the FULL rendered manifest, not just
+    spec.replicas: an operator image bump (or any env/resource change in
+    the rendered manifest) re-applies even though replicas match."""
+    import json
+
+    from dynamo_tpu.deploy.crd import graph_to_cr
+    from dynamo_tpu.deploy.reconciler import HASH_ANNOTATION, KubeReconciler
+    from dynamo_tpu.deploy.spec import GraphDeployment, ServiceSpec
+
+    state = tmp_path / "kube.json"
+    stub = tmp_path / "kubectl"
+    stub.write_text(FAKE_KUBE.format(state_path=str(state)))
+    stub.chmod(0o755)
+
+    graph = GraphDeployment(
+        name="g1", namespace="prod",
+        services={"decode": ServiceSpec(name="decode", command=["wk"], replicas=2)},
+    )
+    state.write_text(json.dumps({"dgds": {"g1": graph_to_cr(graph)}, "deployments": {}}))
+
+    rec1 = KubeReconciler(namespace="prod", kubectl_cmd=[str(stub)], image="img:1")
+    await rec1.reconcile_once()
+    dep = json.loads(state.read_text())["deployments"]["g1-decode"]
+    assert HASH_ANNOTATION in dep["metadata"]["annotations"]
+    img1 = json.dumps(dep).count("img:1")
+    assert img1 >= 1
+
+    # Same spec, same replicas — a new operator image changes the rendered
+    # manifest; the old replicas-only comparison skipped this re-apply.
+    rec2 = KubeReconciler(namespace="prod", kubectl_cmd=[str(stub)], image="img:2")
+    await rec2.reconcile_once()
+    s = json.loads(state.read_text())
+    dep2 = s["deployments"]["g1-decode"]
+    assert "img:2" in json.dumps(dep2), "image drift was not re-applied"
+    assert dep2["metadata"]["annotations"][HASH_ANNOTATION] != dep["metadata"]["annotations"][HASH_ANNOTATION]
+
+    # Steady state: a third pass with the same image applies nothing new
+    # (hash matches) — replicas and image unchanged.
+    before = json.dumps(s["deployments"])
+    await rec2.reconcile_once()
+    assert json.dumps(json.loads(state.read_text())["deployments"]) == before
+
+    # Out-of-band replica drift on the LIVE object (annotation intact) is
+    # still reverted via the replicas check.
+    s = json.loads(state.read_text())
+    s["deployments"]["g1-decode"]["spec"]["replicas"] = 7
+    state.write_text(json.dumps(s))
+    await rec2.reconcile_once()
+    assert json.loads(state.read_text())["deployments"]["g1-decode"]["spec"]["replicas"] == 2

@@ -28,6 +28,25 @@ def test_quantize_roundtrip_accuracy():
     assert float(err) <= (0.5 / 127.0) * 1.01
 
 
+def test_dequant_to_bf16_error_within_quant_floor():
+    """wt() must dequantize in f32 and only cast the PRODUCT to the compute
+    dtype: the error vs the exact f32 product is then pure bf16 output
+    rounding (≤ 2^-9 relative), not the compounded ~0.4% that multiplying
+    a bf16-rounded scale introduced (regression bound for quant.py)."""
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 96), jnp.float32) * 0.07
+    from dynamo_tpu.engine.quant import quantize_weight
+
+    qw = quantize_weight(w)
+    exact = qw.q.astype(jnp.float32) * qw.scale  # the true dequant value
+    got = wt(qw, jnp.bfloat16).astype(jnp.float32)
+    rel = np.abs(np.asarray(got - exact)) / np.maximum(np.abs(np.asarray(exact)), 1e-9)
+    # bf16 keeps 8 bits of precision: correct rounding of the f32 product
+    # stays within a half-ULP (2^-8 relative). The old bf16×bf16 path
+    # measured ~1.7× past this bound (double rounding through the bf16
+    # scale — ~0.4% worst-case), so this pins the f32-dequant behavior.
+    assert float(rel.max()) <= 2.0 ** -8 * 1.001
+
+
 def test_quantized_decode_matches_dense_closely():
     cfg = get_config("tiny")
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)

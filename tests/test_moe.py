@@ -269,3 +269,26 @@ def test_moe_capacity_inactive_lanes_cannot_steal_slots():
     np.testing.assert_allclose(np.asarray(out_masked[-1]), np.asarray(ref[0]), rtol=1e-5, atol=1e-5)
     # And the dead lanes contribute nothing.
     np.testing.assert_allclose(np.asarray(out_masked[:-1]), 0.0, atol=1e-6)
+
+
+def test_moe_counters_drain_on_direct_read():
+    """moe_dropped_total / moe_assignments_total are drained-on-read
+    properties: jitted steps stage aux scalars in _pending_aux (no per-step
+    host sync), so a direct reader — not just metrics() — must see them
+    (regression: stale counters for anyone bypassing metrics())."""
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig
+
+    cfg = CFG.replace(moe_dispatch="capacity")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    sched = Scheduler(cfg, params, SchedulerConfig(num_blocks=16), dtype=jnp.float32)
+    assert sched._moe_stats
+
+    sched._pending_aux.append((jnp.int32(3), jnp.int32(40)))
+    sched._pending_aux.append((jnp.int32(2), jnp.int32(24)))
+    assert sched.moe_dropped_total == 5
+    assert sched.moe_assignments_total == 64
+    assert not sched._pending_aux  # drained, not double-counted
+    assert sched.moe_dropped_total == 5
+
+    m = sched.metrics()
+    assert m.moe_dropped_total == 5 and m.moe_assignments_total == 64
