@@ -38,6 +38,7 @@ from dynamo_tpu.engine.scheduler import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -158,6 +159,9 @@ class TpuEngine:
         if args.warmup_ctx > 0:
             n = engine.scheduler.warmup(args.warmup_ctx)
             logger.info("warmed %d executables (ctx %d)", n, args.warmup_ctx)
+        # From here on, compiles are mid-traffic: the flight recorder counts
+        # them (and alerts when a warmup pass was supposed to cover them).
+        engine.scheduler.flight.mark_warmup_done(warmed=args.warmup_ctx > 0)
         if args.kvbm_host_blocks > 0:
             from dynamo_tpu.llm.block_manager import KvBlockManager
 
@@ -249,6 +253,13 @@ class TpuEngine:
             frequency_penalty=float(sampling_d.get("frequency_penalty") or 0.0),
             presence_penalty=float(sampling_d.get("presence_penalty") or 0.0),
         )
+        logit_bias = sampling_d.get("logit_bias")
+        if logit_bias:
+            from dynamo_tpu.logits_processing import LogitBiasProcessor
+
+            # Applied pre-sampling via the per-request processor chain (the
+            # host path — logit_bias rows skip the batched fast paths).
+            sampling.logits_processors = [LogitBiasProcessor(logit_bias)]
         stop = StopConditions.from_dict(request.get("stop_conditions"))
         disagg = request.get("disagg_params") or {}
         # keep_blocks: prefill role (decode worker will pull the KV);
@@ -264,6 +275,13 @@ class TpuEngine:
             extras["mm_features"] = (
                 mm if hasattr(mm, "shape") else features_from_wire(mm)
             )
+        # Request tracing: hand the scheduler the (trace_id, parent_span)
+        # pair only for sampled traces — the deterministic head-sampling
+        # decision matches the frontend's, so one request is one trace.
+        tracer = get_tracer()
+        tp = context.traceparent
+        if tracer.enabled and tp is not None and tracer.sampled(tp.trace_id):
+            extras["trace"] = (tp.trace_id, tp.parent_id)
         queue: "asyncio.Queue[StepOutput]" = asyncio.Queue()
         self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue, extras))
         self._wake.set()
@@ -360,14 +378,22 @@ class TpuEngine:
 
     def stats_handler(self) -> dict:
         m = self.scheduler.metrics()
-        return {
+        stats = {
             "kv_usage": m.kv_usage,
+            "kv_total_blocks": m.kv_total_blocks,
+            "kv_active_blocks": m.kv_active_blocks,
             "num_running": m.num_running,
             "num_waiting": m.num_waiting,
+            "preemptions_total": self.scheduler.preempt_total,
             # Mixed-step composition (scrape-visible so the planner and
             # dashboards can see how much prefill rides the decode wave —
-            # runtime/metrics.py documents the derived gauges).
+            # runtime/metrics.py documents the derived counters).
             "mixed_steps_total": m.mixed_steps_total,
             "mixed_prefill_tokens_total": m.mixed_prefill_tokens_total,
             "mixed_decode_tokens_total": m.mixed_decode_tokens_total,
         }
+        # Flight recorder: per-phase step/token counters + the XLA compile
+        # tracker (compiles_after_warmup_total > 0 in steady state is the
+        # alert that shapes are compiling mid-traffic — PR 1's silent killer).
+        stats.update(self.scheduler.flight.to_stats())
+        return stats

@@ -1,0 +1,145 @@
+"""Engine flight recorder: step histograms + XLA compile tracking.
+
+The scheduler's step loop is where serving latency is actually spent, but
+until now its only outputs were aggregate counters. The flight recorder
+keeps a host-side, allocation-free account of every dispatch:
+
+- **Step-duration histograms labelled by phase** (prefill / decode / mixed /
+  wave / spec) with per-phase token counts — the per-step token throughput
+  and the "where did this request's time go" denominator.
+- **An XLA compile tracker.** Executables are keyed by their static shape
+  tuple (the same keys ``Scheduler.warmup`` precompiles). Every dispatch
+  registers its key; a key first seen *after* warmup completed means XLA
+  compiled mid-traffic — PR 1's silent killer (decode executables compiling
+  under load, measured as the dominant serving-plane latency) — and is
+  counted and logged with its shape key so it alerts instead of hiding in
+  p99.
+
+Everything is plain Python ints/floats mutated from the step thread and
+read from the event loop via ``to_stats()`` — last-write-wins races on a
+scrape are acceptable for monitoring data, so no locks on the hot path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Step durations span sub-ms CPU mock steps to multi-second cold compiles.
+STEP_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+PHASES = ("prefill", "decode", "mixed", "wave", "spec")
+
+
+class _PhaseHist:
+    __slots__ = ("counts", "total", "sum_s", "tokens")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(STEP_BUCKETS) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+        self.tokens = 0
+
+    def observe(self, dur_s: float, tokens: int) -> None:
+        self.counts[bisect.bisect_left(STEP_BUCKETS, dur_s)] += 1
+        self.total += 1
+        self.sum_s += dur_s
+        self.tokens += tokens
+
+
+class FlightRecorder:
+    """Owned by one Scheduler; mutated on the step thread only."""
+
+    def __init__(self) -> None:
+        self._hists: Dict[str, _PhaseHist] = {p: _PhaseHist() for p in PHASES}
+        # Compile tracker state.
+        self._exec_keys: Set[tuple] = set()
+        self.compiles_total = 0
+        self.compiles_after_warmup_total = 0
+        self.post_warmup_keys: List[tuple] = []
+        self._warmup_done = False
+        self._warmed = False  # did a real warmup() pass run before traffic?
+        # Last-step snapshot (gauge-style, for quick introspection).
+        self.last_step_phase: Optional[str] = None
+        self.last_step_s = 0.0
+
+    # --- step accounting ----------------------------------------------------
+    def record_step(self, phase: str, dur_s: float, tokens: int) -> None:
+        h = self._hists.get(phase)
+        if h is None:
+            h = self._hists.setdefault(phase, _PhaseHist())
+        h.observe(dur_s, tokens)
+        self.last_step_phase = phase
+        self.last_step_s = dur_s
+
+    # --- compile tracking ---------------------------------------------------
+    def record_exec(self, kind: str, key: tuple) -> bool:
+        """Register a dispatch's executable shape key. Returns True when the
+        key is new (== XLA compiled for it). New keys after warmup are the
+        alert condition."""
+        k = (kind,) + tuple(key)
+        if k in self._exec_keys:
+            return False
+        self._exec_keys.add(k)
+        self.compiles_total += 1
+        if self._warmup_done:
+            self.compiles_after_warmup_total += 1
+            self.post_warmup_keys.append(k)
+            # A warmed engine compiling mid-traffic is a coverage bug worth
+            # alerting on; an engine that skipped warmup compiles lazily by
+            # design — record it, but don't cry wolf.
+            log = logger.warning if self._warmed else logger.debug
+            log("XLA compile after warmup: %s %s (post-warmup compiles: %d)",
+                kind, key, self.compiles_after_warmup_total)
+        return True
+
+    def mark_warmup_done(self, warmed: bool) -> None:
+        """Called once traffic may start. ``warmed`` = a warmup() pass
+        actually precompiled the serving set (compiles after this point are
+        unexpected); False = lazy compilation is expected but still
+        counted."""
+        self._warmup_done = True
+        self._warmed = warmed
+
+    # --- export -------------------------------------------------------------
+    def to_stats(self) -> dict:
+        """Flat dict merged into the worker stats scrape (monotonic keys end
+        in ``_total`` so the aggregator exports them as Counters)."""
+        out: dict = {
+            "compiles_total": self.compiles_total,
+            "compiles_after_warmup_total": self.compiles_after_warmup_total,
+        }
+        for phase, h in self._hists.items():
+            if not h.total and phase not in ("prefill", "decode", "mixed"):
+                continue  # wave/spec only when the path is exercised
+            out[f"step_{phase}_steps_total"] = h.total
+            out[f"step_{phase}_time_seconds_total"] = round(h.sum_s, 6)
+            out[f"step_{phase}_tokens_total"] = h.tokens
+        return out
+
+    def histogram(self, phase: str) -> Tuple[Tuple[float, ...], List[int]]:
+        """(bucket upper bounds, counts incl. +Inf) for one phase."""
+        h = self._hists[phase]
+        return STEP_BUCKETS, list(h.counts)
+
+
+class StepTimer:
+    """Tiny context helper: ``with StepTimer() as t: ...; flight.record_step
+    (phase, t.dur, n)`` without try/finally noise at each dispatch site."""
+
+    __slots__ = ("t0", "dur")
+
+    def __enter__(self) -> "StepTimer":
+        self.t0 = time.perf_counter()
+        self.dur = 0.0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.dur = time.perf_counter() - self.t0

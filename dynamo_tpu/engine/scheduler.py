@@ -39,11 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.flight_recorder import FlightRecorder, StepTimer
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays, KvEvent, OutOfBlocksError
 from dynamo_tpu.engine.models import llama
 from dynamo_tpu.engine.sampling import SamplingParams, sample_batch
 from dynamo_tpu.llm.tokens import extend_block_hashes
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -151,6 +153,9 @@ class Sequence:
     # Chosen-token logprob computed by the single-row sampler, consumed by
     # the next _append_token (sampling.logprobs requests).
     _pending_logprob: Optional[float] = None
+    # Request tracing: (trace_id, parent_span_id) when this request's trace
+    # is sampled; None keeps the scheduler's trace path one branch.
+    trace: Optional[tuple] = None
 
     @property
     def all_ids(self) -> List[int]:
@@ -312,6 +317,12 @@ class Scheduler:
         self._eos = eos_token_ids or []
         self._rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
+        # Flight recorder: per-phase step histograms + XLA compile tracker
+        # (every dispatch registers its shape key; keys first seen after
+        # warmup are counted/logged). Tracer: per-request lifecycle events
+        # for sequences whose trace is sampled.
+        self.flight = FlightRecorder()
+        self.tracer = get_tracer()
 
         # Trim buckets to the model's max length.
         self.sc.prefill_buckets = [b for b in self.sc.prefill_buckets if b <= model_config.max_seq_len] or [
@@ -494,6 +505,7 @@ class Scheduler:
         keep_blocks_on_finish: bool = False,
         prefilled: Optional[dict] = None,
         mm_features: Optional[np.ndarray] = None,
+        trace: Optional[tuple] = None,
     ) -> Sequence:
         if not token_ids:
             raise ValueError("empty prompt")
@@ -513,10 +525,12 @@ class Scheduler:
             keep_blocks_on_finish=keep_blocks_on_finish,
             prefilled=prefilled,
             mm_features=mm_features,
+            trace=trace,
         )
         self.waiting.append(seq)
         self.by_id[request_id] = seq
         self.request_total += 1
+        self._trace_event(seq, "queued", prompt_tokens=len(token_ids))
         return seq
 
     def abort(self, request_id: str) -> None:
@@ -702,20 +716,30 @@ class Scheduler:
             tables[i, : len(s.block_ids)] = s.block_ids
             active[i] = True
 
-        res = self._get_mixed_jit((s_bucket, p_table.shape[0], d_bucket, width))(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(p_tok), jnp.int32(len(chunk_tokens)), jnp.int32(seq.num_computed),
-            p_table, jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(active), has_prefix,
+        mixed_key = (s_bucket, int(p_table.shape[0]), d_bucket, width)
+        self.flight.record_exec(
+            "mixed", mixed_key + ((has_prefix,) if self._use_flash_prefill else ())
         )
-        logits, self.cache.k, self.cache.v = self._consume_aux(res)
-        self.mixed_steps_total += 1
-        self.mixed_prefill_tokens_total += len(chunk_tokens)
-        self.mixed_decode_tokens_total += n
+        with StepTimer() as timer:
+            res = self._get_mixed_jit(mixed_key)(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(p_tok), jnp.int32(len(chunk_tokens)), jnp.int32(seq.num_computed),
+                p_table, jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(active), has_prefix,
+            )
+            logits, self.cache.k, self.cache.v = self._consume_aux(res)
+            self.mixed_steps_total += 1
+            self.mixed_prefill_tokens_total += len(chunk_tokens)
+            self.mixed_decode_tokens_total += n
 
-        # Decode rows first (output-order parity with the phase-separated
-        # decode-then-admit iteration), then the chunk's progress.
-        self._finish_decode_rows(batch, d_bucket, logits[1:], outputs)
+            # Decode rows first (output-order parity with the phase-separated
+            # decode-then-admit iteration), then the chunk's progress.
+            self._finish_decode_rows(batch, d_bucket, logits[1:], outputs)
+        self.flight.record_step("mixed", timer.dur, len(chunk_tokens) + n)
+        self._trace_event(
+            seq, "mixed_ride", chunk_tokens=len(chunk_tokens), decode_rows=n,
+            dur_s=round(timer.dur, 6),
+        )
 
         seq.num_computed += len(chunk_tokens)
         if seq.num_computed < len(pf_tokens):
@@ -882,27 +906,30 @@ class Scheduler:
             valid[i] = len(chunk)
             tables[i, : len(seq.block_ids)] = seq.block_ids
 
-        res = self._get_admit_jit((b_bucket, s_bucket, width))(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens), jnp.asarray(pos0), jnp.asarray(valid), jnp.asarray(tables),
-        )
-        lg, self.cache.k, self.cache.v = self._consume_aux(res)
-        self._step_counter += 1
-        skey = jax.random.fold_in(self._rng, self._step_counter)
-        sampled = np.asarray(
-            self._sample_jit(
-                lg, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), skey, None
+        self.flight.record_exec("admit", (b_bucket, s_bucket, width))
+        with StepTimer() as timer:
+            res = self._get_admit_jit((b_bucket, s_bucket, width))(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(pos0), jnp.asarray(valid), jnp.asarray(tables),
             )
-        )  # the wave's ONE host sync
+            lg, self.cache.k, self.cache.v = self._consume_aux(res)
+            self._step_counter += 1
+            skey = jax.random.fold_in(self._rng, self._step_counter)
+            sampled = np.asarray(
+                self._sample_jit(
+                    lg, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), skey, None
+                )
+            )  # the wave's ONE host sync
 
-        for i, seq in enumerate(admitted):
-            self.waiting.remove(seq)
-            seq.num_computed = len(seq.prompt)
-            seq.first_token_ts = time.monotonic()
-            seq.state = SeqState.RUNNING
-            self.running.append(seq)
-            self._register_full_blocks(seq)
-            self._append_token(seq, int(sampled[i]), outputs)
+            for i, seq in enumerate(admitted):
+                self.waiting.remove(seq)
+                seq.num_computed = len(seq.prompt)
+                seq.first_token_ts = time.monotonic()
+                seq.state = SeqState.RUNNING
+                self.running.append(seq)
+                self._register_full_blocks(seq)
+                self._append_token(seq, int(sampled[i]), outputs)
+        self.flight.record_step("wave", timer.dur, int(valid.sum()) + len(admitted))
         return True
 
     def _first_touch(self, seq: Sequence, pf_tokens: List[int], total_tokens: int) -> None:
@@ -934,6 +961,11 @@ class Scheduler:
         seq.state = SeqState.PREFILL
         if seq.admitted_ts is None:
             seq.admitted_ts = time.monotonic()
+            self._trace_event(
+                seq, "admitted",
+                queue_s=round(seq.admitted_ts - seq.arrival_ts, 6),
+                cached_blocks=seq.num_cached_blocks,
+            )
 
     def _prefill_one(self, seq: Sequence, outputs: List[tuple]) -> bool:
         """Run one prefill chunk for ``seq``. Returns True when the prompt is
@@ -964,31 +996,45 @@ class Scheduler:
         table = self._prefill_table(seq)
 
         t0 = time.monotonic() if self.sc.itl_budget_ms else None
-        if seq.mm_features is not None:
-            feats = seq.mm_features
-            fb = 16
-            while fb < feats.shape[0]:
-                fb *= 2
-            padded_f = np.zeros((fb, feats.shape[1]), dtype=np.float32)
-            padded_f[: feats.shape[0]] = feats
-            res = self._prefill_mm_jit()(
-                self.params, self.cache.k, self.cache.v,
-                jnp.asarray(padded), jnp.int32(len(tokens)), jnp.int32(seq.num_computed),
-                table, seq.num_computed > 0,
-                jnp.asarray(padded_f), jnp.int32(feats.shape[0]),
-            )
-        else:
-            res = self._prefill_jit(
-                self.params,
-                self.cache.k,
-                self.cache.v,
-                jnp.asarray(padded),
-                jnp.int32(len(tokens)),
-                jnp.int32(seq.num_computed),
-                table,
-                seq.num_computed > 0,
-            )
-        logits, self.cache.k, self.cache.v = self._consume_aux(res)
+        with StepTimer() as timer:
+            if seq.mm_features is not None:
+                feats = seq.mm_features
+                fb = 16
+                while fb < feats.shape[0]:
+                    fb *= 2
+                padded_f = np.zeros((fb, feats.shape[1]), dtype=np.float32)
+                padded_f[: feats.shape[0]] = feats
+                self.flight.record_exec(
+                    "prefill_mm", (bucket, int(table.shape[0]), fb, seq.num_computed > 0)
+                )
+                res = self._prefill_mm_jit()(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(padded), jnp.int32(len(tokens)), jnp.int32(seq.num_computed),
+                    table, seq.num_computed > 0,
+                    jnp.asarray(padded_f), jnp.int32(feats.shape[0]),
+                )
+            else:
+                # Shape key mirrors warmup(): on the XLA path has_prefix is a
+                # traced no-op arg (one executable serves both values).
+                hp_key = (seq.num_computed > 0) if self._use_flash_prefill else False
+                self.flight.record_exec("prefill", (bucket, int(table.shape[0]), hp_key))
+                res = self._prefill_jit(
+                    self.params,
+                    self.cache.k,
+                    self.cache.v,
+                    jnp.asarray(padded),
+                    jnp.int32(len(tokens)),
+                    jnp.int32(seq.num_computed),
+                    table,
+                    seq.num_computed > 0,
+                )
+            logits, self.cache.k, self.cache.v = self._consume_aux(res)
+        self.flight.record_step("prefill", timer.dur, len(tokens))
+        self._trace_event(
+            seq, "prefill_chunk", tokens=len(tokens), bucket=bucket,
+            computed=seq.num_computed + len(tokens), dur_s=round(timer.dur, 6),
+            resume=resuming,
+        )
         if t0 is not None:
             # Sync to learn the chunk rate (feeds _chunk_budget's EMA).
             logits.block_until_ready()
@@ -1010,6 +1056,7 @@ class Scheduler:
             seq.state = SeqState.RUNNING
             self.running.append(seq)
             self._register_full_blocks(seq)
+            self._trace_event(seq, "resume", total_len=seq.total_len)
             return True
 
         # Prompt fully computed: sample the first token.
@@ -1066,6 +1113,7 @@ class Scheduler:
                 temps = jnp.zeros((bucket,), jnp.float32)
                 tks = jnp.zeros((bucket,), jnp.int32)
                 tps = jnp.ones((bucket,), jnp.float32)
+                self.flight.record_exec("decode", (bucket, width))
                 logits, self.cache.k, self.cache.v = self._consume_aux(
                     self._decode_jit(
                         self.params, self.cache.k, self.cache.v, toks, pos, tables, active
@@ -1073,7 +1121,8 @@ class Scheduler:
                 )
                 count += 1
                 if self.sc.num_scheduler_steps > 1 and self._supports_multi_step:
-                    for mjit in self._decode_multi_jits.values():
+                    for w, mjit in self._decode_multi_jits.items():
+                        self.flight.record_exec("decode_multi", (w, bucket, width))
                         _, self.cache.k, self.cache.v = self._consume_aux(
                             mjit(
                                 self.params, self.cache.k, self.cache.v, toks, pos, tables,
@@ -1112,6 +1161,9 @@ class Scheduler:
                 # prefix-hit continuations. (On the XLA path hp is a traced
                 # no-op arg, so the second call is a cache hit.)
                 for hp in (False, True):
+                    self.flight.record_exec(
+                        "prefill", (bucket, width, hp if self._use_flash_prefill else False)
+                    )
                     _, self.cache.k, self.cache.v = self._consume_aux(
                         self._prefill_jit(
                             self.params, self.cache.k, self.cache.v,
@@ -1139,6 +1191,7 @@ class Scheduler:
             # lazily, but the standard burst-arrival case is covered.
             if self._supports_chunk_admit and self.draft_params is None:
                 b_b = self.sc.decode_buckets[-1]
+                self.flight.record_exec("admit", (b_b, bucket, min_w))
                 _, self.cache.k, self.cache.v = self._consume_aux(
                     self._get_admit_jit((b_b, bucket, min_w))(
                         self.params, self.cache.k, self.cache.v,
@@ -1165,6 +1218,11 @@ class Scheduler:
             p_w = max(16, width_bucket(1, self.max_blocks_per_seq))
             for bucket in self.sc.decode_buckets:
                 for width in widths:
+                    self.flight.record_exec(
+                        "mixed",
+                        (s_b, p_w, bucket, width)
+                        + ((False,) if self._use_flash_prefill else ()),
+                    )
                     res = self._get_mixed_jit((s_b, p_w, bucket, width))(
                         self.params, self.cache.k, self.cache.v,
                         jnp.zeros((s_b,), jnp.int32), jnp.int32(1), jnp.int32(0),
@@ -1261,18 +1319,21 @@ class Scheduler:
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
 
-        logits, self.cache.k, self.cache.v = self._consume_aux(
-            self._decode_jit(
-                self.params,
-                self.cache.k,
-                self.cache.v,
-                jnp.asarray(tokens),
-                jnp.asarray(positions),
-                jnp.asarray(tables),
-                jnp.asarray(active),
+        self.flight.record_exec("decode", (bucket, width))
+        with StepTimer() as timer:
+            logits, self.cache.k, self.cache.v = self._consume_aux(
+                self._decode_jit(
+                    self.params,
+                    self.cache.k,
+                    self.cache.v,
+                    jnp.asarray(tokens),
+                    jnp.asarray(positions),
+                    jnp.asarray(tables),
+                    jnp.asarray(active),
+                )
             )
-        )
-        self._finish_decode_rows(batch, bucket, logits, outputs)
+            self._finish_decode_rows(batch, bucket, logits, outputs)
+        self.flight.record_step("decode", timer.dur, len(outputs))
         return outputs
 
     def _finish_decode_rows(
@@ -1397,20 +1458,24 @@ class Scheduler:
 
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
-        res = self._decode_multi_jits[steps](
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), key,
-        )
-        toks_out, self.cache.k, self.cache.v = self._consume_aux(res)
-        sampled = np.asarray(toks_out)  # [steps, bucket] — the one host sync
+        self.flight.record_exec("decode_multi", (steps, bucket, width))
+        n0 = len(outputs)
+        with StepTimer() as timer:
+            res = self._decode_multi_jits[steps](
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), key,
+            )
+            toks_out, self.cache.k, self.cache.v = self._consume_aux(res)
+            sampled = np.asarray(toks_out)  # [steps, bucket] — the one host sync
 
-        for i, seq in enumerate(batch):
-            for s in range(steps):
-                if seq.state != SeqState.RUNNING:
-                    break  # stopped mid-window; later tokens are trimmed
-                self._append_token(seq, int(sampled[s, i]), outputs)
+            for i, seq in enumerate(batch):
+                for s in range(steps):
+                    if seq.state != SeqState.RUNNING:
+                        break  # stopped mid-window; later tokens are trimmed
+                    self._append_token(seq, int(sampled[s, i]), outputs)
+        self.flight.record_step("decode", timer.dur, len(outputs) - n0)
         return True
 
     def _decode_spec(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
@@ -1445,6 +1510,9 @@ class Scheduler:
 
         B = bucket
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
+        self.flight.record_exec("spec", (gamma, B, width))
+        n0 = len(outputs)
+        t_round = time.perf_counter()
         tables = np.zeros((B, width), dtype=np.int32)
         d_toks = np.zeros((B, S), dtype=np.int32)
         d_pos0 = np.zeros((B,), dtype=np.int32)
@@ -1532,6 +1600,7 @@ class Scheduler:
             # inputs covered positions old_total..old_total+γ-2, of which the
             # first min(k, γ-1) carry accepted (confirmed) tokens.
             seq.d_n = old_total + min(k, gamma - 1)
+        self.flight.record_step("spec", time.perf_counter() - t_round, len(outputs) - n0)
         return True
 
     # --- disaggregation support ---------------------------------------------
@@ -1565,6 +1634,10 @@ class Scheduler:
         seq.state = SeqState.RUNNING
         seq.first_token_ts = time.monotonic()
         self.running.append(seq)
+        self._trace_event(
+            seq, "disagg_inject", blocks=len(seq.block_ids),
+            device_native="device_blocks" in data,
+        )
         self._append_token(seq, int(data["first_token"]), outputs)
         seq.prefilled = None  # consumed — a later preemption resumes via recompute
         return True
@@ -1614,6 +1687,17 @@ class Scheduler:
         return len(expired)
 
     # --- helpers ------------------------------------------------------------
+    def _trace_event(self, seq: Sequence, name: str, **attrs) -> None:
+        """Lifecycle event on the request's trace (no-op when unsampled —
+        ``seq.trace`` is only set for sampled requests, so the hot path
+        pays one None check)."""
+        if seq.trace is None:
+            return
+        self.tracer.event(
+            name, seq.trace[0], parent_id=seq.trace[1], service="scheduler",
+            request_id=seq.request_id, **attrs,
+        )
+
     def attach_kvbm(self, kvbm) -> None:
         """Enable tiered offload/onboard (KVBM G2/G3) for this scheduler."""
         self.kvbm = kvbm
@@ -1724,6 +1808,9 @@ class Scheduler:
         victim.preemptions += 1
         self.preempt_total += 1
         self.waiting.insert(0, victim)
+        self._trace_event(
+            victim, "preempted", total_len=victim.total_len, for_request=needy.request_id
+        )
         logger.info("preempted %s (len %d) to free blocks", victim.request_id, victim.total_len)
         return True
 
@@ -1795,8 +1882,13 @@ class Scheduler:
         seq.output_ids.append(token)
         # First token carries the request's queue time (arrival → admission).
         queue_s = None
-        if len(seq.output_ids) == 1 and seq.admitted_ts is not None:
-            queue_s = max(0.0, seq.admitted_ts - seq.arrival_ts)
+        if len(seq.output_ids) == 1:
+            if seq.admitted_ts is not None:
+                queue_s = max(0.0, seq.admitted_ts - seq.arrival_ts)
+            self._trace_event(
+                seq, "first_token",
+                ttft_s=round(time.monotonic() - seq.arrival_ts, 6),
+            )
         reason = self._check_stop(seq, token)
         if reason is not None:
             # Token that triggered 'stop' is still emitted (backend strips).
@@ -1834,6 +1926,10 @@ class Scheduler:
         if seq in self.running:
             self.running.remove(seq)
         seq.state = SeqState.FINISHED
+        self._trace_event(
+            seq, "finish", reason=reason, output_tokens=len(seq.output_ids),
+            preemptions=seq.preemptions,
+        )
         # Extend hashes over generated tokens so completed output blocks are
         # reusable too (multi-turn: next request's prompt includes them).
         # mm sequences never register: placeholder ids don't hash the image.

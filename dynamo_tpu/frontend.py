@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tls-key-path", default=None, help="PEM private key")
     p.add_argument("--encode-component", default=None,
                    help="route image content parts to this encode-worker component (multimodal)")
+    # Request tracing (runtime/tracing.py): JSONL span export + sampling.
+    # Defaults come from DYN_TRACE_FILE / DYN_TRACE_SAMPLE.
+    p.add_argument("--trace-file", default=None, help="JSONL span export path (enables tracing)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="trace sampling ratio in [0,1]; decision is per-trace-id (default 1.0)")
     return p
 
 
@@ -65,8 +70,12 @@ async def amain(args) -> None:
 
 def main() -> None:
     init_logging()
+    args = build_parser().parse_args()
+    from dynamo_tpu.runtime.tracing import configure_tracing
+
+    configure_tracing(path=args.trace_file, sample=args.trace_sample, service="frontend")
     try:
-        asyncio.run(amain(build_parser().parse_args()))
+        asyncio.run(amain(args))
     except KeyboardInterrupt:
         pass
 

@@ -497,6 +497,15 @@ class DisaggDecodeHandler:
         prefill_req["stop_conditions"] = {**(request.get("stop_conditions") or {}), "max_tokens": 1, "ignore_eos": True}
         prefill_req["disagg_params"] = {"do_remote_decode": True}
         prefill_ctx = context.child()  # same request id crosses the wire
+        tp = context.traceparent
+        if tp is not None:
+            from dynamo_tpu.runtime.tracing import get_tracer
+
+            get_tracer().event(
+                "disagg_hop", tp.trace_id, parent_id=tp.parent_id, service="worker",
+                request_id=context.id, prompt_tokens=len(tokens),
+                strategy=self.strategy, kv_transfer=self.kv_transfer,
+            )
 
         try:
             if self.strategy == "prefill_first":

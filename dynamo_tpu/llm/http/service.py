@@ -26,6 +26,7 @@ from dynamo_tpu.llm.protocols import openai as oai
 from dynamo_tpu.llm.protocols.common import LLMEngineOutput, as_engine_output
 from dynamo_tpu.runtime.engine import Annotated, Context
 from dynamo_tpu.runtime.logging import TraceParent, get_logger
+from dynamo_tpu.runtime.tracing import NULL_SPAN, get_tracer
 from dynamo_tpu.runtime.metrics import (
     DURATION_BUCKETS,
     FRONTEND_PREFIX,
@@ -299,16 +300,17 @@ class HttpService:
         protocols/openai/responses.rs): response.created →
         output_item.added → content_part.added → output_text.delta* →
         *.done → (function_call items) → response.completed."""
+        ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
         resp = web.StreamResponse(
             status=200,
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **_trace_headers(ctx),
             },
         )
         await resp.prepare(request)
-        ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
         seq = [0]
         start = time.monotonic()
 
@@ -447,6 +449,16 @@ class HttpService:
 
         stream = bool(body.get("stream", False))
         ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
+        # Root (or continuation) span for the request. When sampled, the
+        # span becomes the parent of every downstream hop: ctx.traceparent
+        # is re-rooted under it, and the same deterministic sampling
+        # decision repeats in the worker and scheduler.
+        span = get_tracer().span_from(
+            "http_request", ctx.traceparent, service="frontend",
+            model=model, kind=kind, stream=stream,
+        )
+        if span is not NULL_SPAN:
+            ctx.traceparent = span.child_traceparent()
         rid = oai.make_id("chatcmpl" if kind == "chat" else "cmpl")
         start = time.monotonic()
         self._m_inflight(model).inc()
@@ -458,10 +470,13 @@ class HttpService:
             # Pipeline-stage rejection (e.g. image parts with no encode
             # path): a client/deployment-configuration 400, not a 500.
             self._m_requests(model, "400").inc()
-            return web.json_response(oai.error_body(str(e)), status=400)
+            return web.json_response(
+                oai.error_body(str(e)), status=400, headers=_trace_headers(ctx)
+            )
         finally:
             self._m_inflight(model).dec()
             self._m_duration(model).observe(time.monotonic() - start)
+            span.end()
 
     @staticmethod
     def _choice_bodies(body: dict) -> list:
@@ -549,10 +564,15 @@ class HttpService:
                 # Pipeline-stage rejection (e.g. image parts with no encode
                 # path): a client/configuration 400, not a server fault.
                 self._m_requests(model, "400").inc()
-                return web.json_response(oai.error_body(str(e)), status=400)
+                return web.json_response(
+                    oai.error_body(str(e)), status=400, headers=_trace_headers(ctx)
+                )
             logger.exception("request %s failed", ctx.id)
             self._m_requests(model, "500").inc()
-            return web.json_response(oai.error_body(str(e), "internal_error", 500), status=500)
+            return web.json_response(
+                oai.error_body(str(e), "internal_error", 500), status=500,
+                headers=_trace_headers(ctx),
+            )
         self._m_requests(model, "200").inc()
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
@@ -565,7 +585,9 @@ class HttpService:
                 )
                 for r in results
             ]
-            return web.json_response(oai.chat_response_multi(rid, model, choices, usage))
+            return web.json_response(
+                oai.chat_response_multi(rid, model, choices, usage), headers=_trace_headers(ctx)
+            )
         choices = [
             oai.completion_choice(
                 r["index"], r["text"], r["finish_reason"],
@@ -574,7 +596,9 @@ class HttpService:
             )
             for r in results
         ]
-        return web.json_response(oai.completion_response_multi(rid, model, choices, usage))
+        return web.json_response(
+            oai.completion_response_multi(rid, model, choices, usage), headers=_trace_headers(ctx)
+        )
 
     async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
         if int(body.get("n") or 1) > 1:
@@ -585,6 +609,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **_trace_headers(ctx),
             },
         )
         await resp.prepare(request)
@@ -672,6 +697,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **_trace_headers(ctx),
             },
         )
         await resp.prepare(request)
@@ -762,6 +788,17 @@ class HttpService:
 
 
 _as_output = as_engine_output
+
+# The request's trace id is echoed on every response (SSE included) so a
+# client report ("this request was slow") maps straight to the JSONL trace
+# and ``tools/trace_view.py`` — even for unsampled requests, where it still
+# correlates with the structured logs.
+TRACE_ID_HEADER = "x-dynamo-trace-id"
+
+
+def _trace_headers(ctx: Context) -> dict:
+    tp = getattr(ctx, "traceparent", None)
+    return {TRACE_ID_HEADER: tp.trace_id} if tp is not None else {}
 
 
 async def _sse(resp: web.StreamResponse, obj: dict) -> None:

@@ -55,6 +55,7 @@ class _MigrationEngine:
                 if attempts_left <= 0 or context.is_stopped():
                     raise
                 attempts_left -= 1
+                self._trace_migration(context, emitted_tokens, attempts_left)
                 logger.warning(
                     "recreating stream for request %s (%d migrations left, %d tokens emitted)",
                     context.id,
@@ -66,6 +67,18 @@ class _MigrationEngine:
                     raise
                 attempts_left -= 1
                 logger.warning("recreating stream for request %s: no instances yet", context.id)
+
+    @staticmethod
+    def _trace_migration(context: Context, emitted: int, attempts_left: int) -> None:
+        tp = context.traceparent
+        if tp is None:
+            return
+        from dynamo_tpu.runtime.tracing import get_tracer
+
+        get_tracer().event(
+            "migration", tp.trace_id, parent_id=tp.parent_id, service="frontend",
+            request_id=context.id, tokens_emitted=emitted, attempts_left=attempts_left,
+        )
 
     @staticmethod
     def _fold(req: dict, new_tokens) -> dict:

@@ -73,6 +73,18 @@ def _validate_common_sampling(body: dict) -> None:
     )
     seed = body.get("seed")
     _require(seed is None or isinstance(seed, int), "seed must be an integer")
+    lb = body.get("logit_bias")
+    if lb is not None:
+        _require(isinstance(lb, dict), "logit_bias must be an object mapping token ids to bias")
+        for k, v in lb.items():
+            _require(
+                isinstance(k, (str, int)) and str(k).lstrip("-").isdigit(),
+                "logit_bias keys must be token ids",
+            )
+            _require(
+                isinstance(v, (int, float)) and not isinstance(v, bool) and -100.0 <= v <= 100.0,
+                "logit_bias values must be numbers in [-100, 100]",
+            )
 
 
 def validate_completion_request(body: dict) -> dict:
@@ -106,6 +118,10 @@ def sampling_from_request(body: dict) -> Dict[str, Any]:
     lp = body.get("logprobs")
     if lp is not None and lp is not False:
         out["logprobs"] = True
+    lb = body.get("logit_bias")
+    if lb:
+        # Normalize keys to ints for the wire (OpenAI clients send strings).
+        out["logit_bias"] = {int(k): float(v) for k, v in lb.items()}
     return out
 
 

@@ -20,6 +20,30 @@ from dynamo_tpu.runtime.metrics import MetricsRegistry
 logger = get_logger(__name__)
 
 
+# Point-in-time worker stats → Gauges.
+GAUGE_KEYS = (
+    "kv_usage", "kv_total_blocks", "kv_active_blocks",
+    "num_running", "num_waiting", "in_flight",
+    "remote_prefills", "local_prefills",
+)
+
+# Monotonic worker stats → Counters (``rate()``-able; a Gauge here breaks
+# PromQL rate/increase semantics). The scrape sees running totals, so the
+# aggregator exports per-scrape deltas; a total going backwards means the
+# worker restarted and the new total is counted from zero.
+COUNTER_KEYS = (
+    "request_total", "preemptions_total",
+    "moe_dropped_total", "moe_assignments_total",
+    "mixed_steps_total", "mixed_prefill_tokens_total", "mixed_decode_tokens_total",
+    "compiles_total", "compiles_after_warmup_total",
+    "step_prefill_steps_total", "step_prefill_time_seconds_total", "step_prefill_tokens_total",
+    "step_decode_steps_total", "step_decode_time_seconds_total", "step_decode_tokens_total",
+    "step_mixed_steps_total", "step_mixed_time_seconds_total", "step_mixed_tokens_total",
+    "step_wave_steps_total", "step_wave_time_seconds_total", "step_wave_tokens_total",
+    "step_spec_steps_total", "step_spec_time_seconds_total", "step_spec_tokens_total",
+)
+
+
 class MetricsAggregator:
     def __init__(self, drt: DistributedRuntime, namespace: str, component: str, endpoint: str, interval_s: float = 2.0):
         self.drt = drt
@@ -30,27 +54,41 @@ class MetricsAggregator:
         self.registry = MetricsRegistry(labels={"namespace": namespace, "component": component})
         self._task: Optional[asyncio.Task] = None
         self.client = None
+        # Last-seen totals per (worker, key) for Counter delta export.
+        self._last: dict = {}
 
     async def start(self) -> None:
         ep = self.drt.namespace(self.namespace).component(self.component).endpoint(self.endpoint_name)
         self.client = await ep.client()
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
+    def export_stats(self, stats: dict) -> None:
+        """Fold one scrape ({worker_id: stats_dict}) into the registry.
+        Separated from the poll loop so tests (and the metrics-hygiene
+        check) can drive it without a control plane."""
+        self.registry.gauge("workers", "live worker instances").set(len(stats))
+        for wid, s in stats.items():
+            labels = {"worker": f"{wid:x}"}
+            for key in GAUGE_KEYS:
+                if key in s:
+                    self.registry.gauge(f"worker_{key}", f"worker {key}", **labels).set(float(s[key]))
+            for key in COUNTER_KEYS:
+                if key not in s:
+                    continue
+                c = self.registry.counter(f"worker_{key}", f"worker {key} (monotonic)", **labels)
+                cur = float(s[key])
+                prev = self._last.get((wid, key))
+                if prev is None or cur < prev:
+                    c.inc(cur)  # first sight, or worker restarted
+                else:
+                    c.inc(cur - prev)
+                self._last[(wid, key)] = cur
+
     async def _loop(self) -> None:
-        g_workers = self.registry.gauge("workers", "live worker instances")
         try:
             while True:
                 stats = await self.client.scrape_stats()
-                g_workers.set(len(stats))
-                for wid, s in stats.items():
-                    labels = {"worker": f"{wid:x}"}
-                    for key in ("kv_usage", "num_running", "num_waiting", "in_flight",
-                                "remote_prefills", "local_prefills",
-                                "moe_dropped_total", "moe_assignments_total",
-                                "mixed_steps_total", "mixed_prefill_tokens_total",
-                                "mixed_decode_tokens_total"):
-                        if key in s:
-                            self.registry.gauge(f"worker_{key}", f"worker {key}", **labels).set(float(s[key]))
+                self.export_stats(stats)
                 await asyncio.sleep(self.interval_s)
         except asyncio.CancelledError:
             pass

@@ -26,6 +26,7 @@ import msgpack
 
 from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import NULL_SPAN, get_tracer
 from dynamo_tpu.runtime.transports.tcp import ConnectionInfo, TcpCallHome
 
 if TYPE_CHECKING:
@@ -275,6 +276,16 @@ class _PushEndpoint:
         conn = payload.get("conn")
         request = payload.get("request")
         self.in_flight[ctx.id] = ctx
+        # Worker-side hop span: continues the caller's trace (the wire
+        # traceparent) and re-roots the context so engine/scheduler events
+        # parent under this instance's span.
+        span = get_tracer().span_from(
+            "worker_handle", ctx.traceparent, service="worker",
+            endpoint=self.instance.endpoint, instance=f"{self.instance.instance_id:x}",
+            request_id=ctx.id,
+        )
+        if span is not NULL_SPAN:
+            ctx.traceparent = span.child_traceparent()
         tracker = self.drt.runtime.shutdown_tracker
         if self.graceful_shutdown:
             tracker.enter()
@@ -309,6 +320,7 @@ class _PushEndpoint:
         except ConnectionError:
             logger.warning("call-home connection failed for request %s", ctx.id)
         finally:
+            span.end()
             if call_home is not None:
                 await call_home.close()
             self.in_flight.pop(ctx.id, None)

@@ -55,8 +55,22 @@ class MetricsRegistry:
                     metric = kind(full, documentation, labelnames=label_names, registry=self.registry, **kwargs)
                 except ValueError:
                     # Already registered on the shared registry by a sibling
-                    # node — reuse the collector.
-                    metric = self.registry._names_to_collectors[full]  # type: ignore[attr-defined]
+                    # node — reuse the collector, but ONLY if its label set
+                    # matches. Silently reusing a collector with different
+                    # labels made ``.labels(**values)`` blow up far from the
+                    # misdeclaring call site (or, worse, record under the
+                    # wrong series).
+                    metric = self.registry._names_to_collectors.get(full)  # type: ignore[attr-defined]
+                    if metric is None:
+                        raise
+                    existing = tuple(getattr(metric, "_labelnames", ()))
+                    if tuple(sorted(existing)) != tuple(sorted(label_names)):
+                        raise ValueError(
+                            f"metric {full!r} already registered with labels "
+                            f"{sorted(existing)}, requested {sorted(label_names)}; "
+                            "sibling registries must declare identical label sets "
+                            "for a shared metric name"
+                        )
                 self._metrics[key] = metric
         return metric
 

@@ -23,6 +23,7 @@ import msgpack
 from dynamo_tpu.runtime.client import Client
 from dynamo_tpu.runtime.engine import Annotated, Context, StreamDisconnect
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -110,6 +111,13 @@ class PushRouter:
         ctx = context or Context()
         chosen = self.select(instance_id)
         instance = self.client.instances[chosen]
+        tp = ctx.traceparent
+        if tp is not None:
+            get_tracer().event(
+                "route", tp.trace_id, parent_id=tp.parent_id, service="frontend",
+                instance=f"{chosen:x}", endpoint=self.client.endpoint.path,
+                mode=self.mode.value,
+            )
 
         local = self.drt.local_engines.get(chosen)
         if local is not None:

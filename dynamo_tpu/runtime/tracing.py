@@ -1,0 +1,340 @@
+"""Distributed request tracing: spans, events, JSONL + Chrome-trace export.
+
+Ref: lib/runtime/src/logging.rs (W3C ``traceparent`` + OTLP span export) and
+lib/llm/src/perf.rs / recorder.rs (timestamped streams, background JSONL
+writer). The reference exports OTLP; here spans land in a JSONL file a
+developer can grep, feed to ``tools/trace_view.py``, or convert to the
+Chrome ``chrome://tracing`` / Perfetto format.
+
+Design constraints (why this is not just the asyncio Recorder from
+``llm/perf.py``):
+
+- **Emitters live on both sides of the thread boundary.** The scheduler
+  emits from the engine's step thread (``asyncio.to_thread``); the HTTP
+  service and ingress loops emit from the event loop. Export therefore
+  rides a ``queue.SimpleQueue`` drained by a daemon writer thread —
+  ``emit`` never blocks and never touches the event loop.
+- **One trace across processes.** Sampling is a deterministic function of
+  the trace id, so the frontend, worker, and scheduler independently reach
+  the same keep/drop decision for a request without coordination.
+- **Zero overhead when off.** ``tracer.enabled`` is a plain attribute;
+  every call site guards on it (or on the per-sequence ``trace`` tuple),
+  so the disabled path is one branch.
+
+Span ids follow W3C trace-context: 32-hex trace ids, 16-hex span ids
+(``runtime/logging.py`` TraceParent is the wire carrier).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import secrets
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterable, List, Optional
+
+from dynamo_tpu.runtime.logging import TraceParent, get_logger
+
+logger = get_logger(__name__)
+
+TRACE_FILE_ENV = "DYN_TRACE_FILE"
+TRACE_SAMPLE_ENV = "DYN_TRACE_SAMPLE"
+
+
+class Span:
+    """An in-flight span. ``end()`` (or the ``with`` block) emits it."""
+
+    __slots__ = ("tracer", "name", "service", "trace_id", "span_id", "parent_id",
+                 "start_ns", "attrs", "events", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, service: str, trace_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.service = service
+        self.trace_id = trace_id
+        self.span_id = secrets.token_hex(8)
+        self.parent_id = parent_id
+        self.start_ns = time.time_ns()
+        self.attrs = attrs
+        self.events: List[dict] = []
+        self._done = False
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Instant event attached to this span's timeline."""
+        self.events.append({"name": name, "ts": time.time_ns() / 1e9, **attrs})
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        rec = {
+            "kind": "span",
+            "name": self.name,
+            "service": self.service,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "ts": self.start_ns / 1e9,
+            "dur_s": (time.time_ns() - self.start_ns) / 1e9,
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        if self.events:
+            rec["events"] = self.events
+        self.tracer._put(rec)
+
+    def child_traceparent(self) -> TraceParent:
+        """Wire carrier for downstream hops: same trace, this span as parent."""
+        return TraceParent(trace_id=self.trace_id, parent_id=self.span_id)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.attrs.setdefault("error", f"{type(exc).__name__}: {exc}")
+        self.end()
+
+
+class _NullSpan:
+    """Span stand-in when the trace is not sampled: every op is a no-op."""
+
+    __slots__ = ()
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Process tracer: sampling decision + non-blocking JSONL export.
+
+    ``emit``/``Span.end`` enqueue records on a thread-safe queue; a daemon
+    writer thread batches them to disk, so neither the event loop nor the
+    engine step thread ever waits on file IO (the perf.py Recorder
+    pattern, portable across the thread boundary)."""
+
+    def __init__(self, path: Optional[str] = None, sample: float = 1.0,
+                 service: str = "dynamo"):
+        self.path = path
+        self.sample = sample
+        self.service = service
+        self.enabled = path is not None and sample > 0.0
+        self.events_written = 0
+        self._queue: "queue.SimpleQueue[Optional[dict]]" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # --- sampling -----------------------------------------------------------
+    def sampled(self, trace_id: str) -> bool:
+        """Deterministic head sampling keyed on the trace id: every process
+        in the request's path reaches the same decision, so one request is
+        either fully traced everywhere or not at all."""
+        if not self.enabled:
+            return False
+        if self.sample >= 1.0:
+            return True
+        # crc32 over the whole id: stable across processes/runs (unlike
+        # hash()) and uniform even for low-entropy ids.
+        frac = (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) / 0xFFFFFFFF
+        return frac < self.sample
+
+    # --- span / event API ---------------------------------------------------
+    def span(self, name: str, trace_id: str, parent_id: Optional[str] = None,
+             service: Optional[str] = None, **attrs: Any):
+        if not self.sampled(trace_id):
+            return NULL_SPAN
+        return Span(self, name, service or self.service, trace_id, parent_id, attrs)
+
+    def span_from(self, name: str, tp: TraceParent, **attrs: Any):
+        """Span continuing a wire TraceParent (its parent_id is the remote
+        caller's span)."""
+        return self.span(name, tp.trace_id, parent_id=tp.parent_id, **attrs)
+
+    def event(self, name: str, trace_id: str, parent_id: Optional[str] = None,
+              service: Optional[str] = None, **attrs: Any) -> None:
+        """Instant (zero-duration) event in a trace."""
+        if not self.sampled(trace_id):
+            return
+        rec = {
+            "kind": "event",
+            "name": name,
+            "service": service or self.service,
+            "trace_id": trace_id,
+            "parent_id": parent_id,
+            "ts": time.time_ns() / 1e9,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        self._put(rec)
+
+    # --- export plumbing ----------------------------------------------------
+    def _put(self, rec: dict) -> None:
+        self._queue.put(rec)
+        self._ensure_writer()
+
+    def _ensure_writer(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._writer, name="trace-writer", daemon=True
+                )
+                self._thread.start()
+
+    def _writer(self) -> None:
+        with open(self.path, "a") as f:
+            while True:
+                item = self._queue.get()
+                if item is None:
+                    return
+                batch = [item]
+                # Batch whatever is already queued into one write+flush.
+                while True:
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        self._drain(f, batch)
+                        return
+                    batch.append(nxt)
+                self._drain(f, batch)
+
+    def _drain(self, f, batch: List[dict]) -> None:
+        for rec in batch:
+            f.write(json.dumps(rec) + "\n")
+        f.flush()
+        self.events_written += len(batch)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Stop the writer after draining everything queued so far. The next
+        emit restarts it — safe to call between requests or at exit."""
+        if self._thread is None or not self._thread.is_alive():
+            return
+        self._queue.put(None)
+        self._thread.join(timeout)
+        self._thread = None
+
+    def close(self) -> None:
+        self.flush()
+        self.enabled = False
+
+
+# --- process-global tracer ---------------------------------------------------
+
+_TRACER = Tracer(path=None, sample=0.0)
+
+
+def configure_tracing(path: Optional[str] = None, sample: Optional[float] = None,
+                      service: Optional[str] = None) -> Tracer:
+    """(Re)configure the process tracer. Falls back to ``DYN_TRACE_FILE`` /
+    ``DYN_TRACE_SAMPLE`` env (the knobs worker/frontend CLIs expose)."""
+    global _TRACER
+    if path is None:
+        path = os.environ.get(TRACE_FILE_ENV) or None
+    if sample is None:
+        try:
+            sample = float(os.environ.get(TRACE_SAMPLE_ENV, "1.0"))
+        except ValueError:
+            sample = 1.0
+    _TRACER.flush()
+    _TRACER = Tracer(path=path, sample=sample, service=service or _TRACER.service)
+    return _TRACER
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+# --- readers / exporters -----------------------------------------------------
+
+
+def read_trace_file(path: str) -> List[dict]:
+    """Parse a JSONL trace file, skipping malformed lines (a crash mid-write
+    must not make the whole file unreadable)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def chrome_trace(records: Iterable[dict]) -> dict:
+    """Convert span/event records to the Chrome trace-event format (loadable
+    in chrome://tracing and Perfetto). Services map to pids; each trace id
+    gets its own tid lane so concurrent requests don't interleave."""
+    services: Dict[str, int] = {}
+    lanes: Dict[str, int] = {}
+    events: List[dict] = []
+
+    def pid(service: str) -> int:
+        if service not in services:
+            services[service] = len(services) + 1
+            events.append({
+                "ph": "M", "pid": services[service], "name": "process_name",
+                "args": {"name": service},
+            })
+        return services[service]
+
+    def tid(trace_id: str) -> int:
+        if trace_id not in lanes:
+            lanes[trace_id] = len(lanes) + 1
+        return lanes[trace_id]
+
+    for rec in records:
+        if rec.get("kind") not in ("span", "event"):
+            continue
+        base = {
+            "pid": pid(rec.get("service") or "dynamo"),
+            "tid": tid(rec.get("trace_id") or "?"),
+            "name": rec.get("name") or "?",
+            "ts": float(rec.get("ts") or 0.0) * 1e6,  # µs
+            "args": {
+                "trace_id": rec.get("trace_id"),
+                "span_id": rec.get("span_id"),
+                "parent_id": rec.get("parent_id"),
+                **(rec.get("attrs") or {}),
+            },
+        }
+        if rec["kind"] == "span":
+            events.append({**base, "ph": "X", "dur": float(rec.get("dur_s") or 0.0) * 1e6})
+            for ev in rec.get("events") or []:
+                events.append({
+                    "ph": "i", "s": "t",
+                    "pid": base["pid"], "tid": base["tid"],
+                    "name": ev.get("name") or "?",
+                    "ts": float(ev.get("ts") or 0.0) * 1e6,
+                    "args": {k: v for k, v in ev.items() if k not in ("name", "ts")},
+                })
+        else:
+            events.append({**base, "ph": "i", "s": "t"})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

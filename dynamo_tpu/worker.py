@@ -76,6 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--coordinator", default=None, help="leader host:port for jax.distributed")
     p.add_argument("--multihost-group", default="default")
+    # Request tracing (runtime/tracing.py): JSONL span export + sampling.
+    # Defaults come from DYN_TRACE_FILE / DYN_TRACE_SAMPLE.
+    p.add_argument("--trace-file", default=None, help="JSONL span export path (enables tracing)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="trace sampling ratio in [0,1]; decision is per-trace-id (default 1.0)")
+    p.add_argument("--warmup-ctx", type=int, default=0,
+                   help="precompile serving executables for contexts up to this many tokens "
+                        "(0 = lazy; the flight recorder then counts mid-traffic compiles)")
     return p
 
 
@@ -133,6 +141,7 @@ async def amain(args) -> None:
                 spec_gamma=args.spec_gamma,
                 kv_cache_dtype=args.kv_cache_dtype,
                 weight_dtype=args.weight_dtype,
+                warmup_ctx=args.warmup_ctx,
             )
         )
         if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
@@ -206,8 +215,13 @@ async def amain(args) -> None:
 
 def main() -> None:
     init_logging()
+    args = build_parser().parse_args()
+    from dynamo_tpu.runtime.tracing import configure_tracing
+
+    configure_tracing(path=args.trace_file, sample=args.trace_sample,
+                      service=f"worker-{args.role}")
     try:
-        asyncio.run(amain(build_parser().parse_args()))
+        asyncio.run(amain(args))
     except KeyboardInterrupt:
         pass
 

@@ -6,7 +6,23 @@ import json
 import os
 import re
 
+from dynamo_tpu.metrics_aggregator import COUNTER_KEYS, GAUGE_KEYS
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _component_families():
+    """Exact family names the aggregator exports (prometheus_client strips a
+    Counter's ``_total`` from the family name and re-appends it on the
+    sample, so the PromQL-visible name keeps the suffix)."""
+    fams = {"dynamo_component_workers"}
+    for key in GAUGE_KEYS:
+        fams.add(f"dynamo_component_worker_{key}")
+    for key in COUNTER_KEYS:
+        fams.add(f"dynamo_component_worker_{key}")
+        if not key.endswith("_total"):
+            fams.add(f"dynamo_component_worker_{key}_total")
+    return fams
 
 
 def test_dashboard_metrics_exist_in_code():
@@ -19,17 +35,32 @@ def test_dashboard_metrics_exist_in_code():
         for m in re.findall(r"dynamo_[a-z_]+", e):
             families.add(re.sub(r"_(bucket|sum|count)$", "", m))
 
-    # Registered names: frontend metrics in llm/http/service.py (prefix
-    # dynamo_frontend_), worker fields forwarded by metrics_aggregator
-    # (prefix dynamo_component_).
+    # Frontend metrics are registered in llm/http/service.py (prefix
+    # dynamo_frontend_); worker stats are forwarded by metrics_aggregator
+    # (prefix dynamo_component_worker_* from GAUGE_KEYS/COUNTER_KEYS).
     src = open(os.path.join(REPO, "dynamo_tpu", "llm", "http", "service.py")).read()
-    agg = open(os.path.join(REPO, "dynamo_tpu", "metrics_aggregator.py")).read()
+    component_fams = _component_families()
     for fam in families:
         if fam.startswith("dynamo_frontend_"):
             short = fam[len("dynamo_frontend_"):]
             assert f'"{short}"' in src, f"dashboard references unregistered {fam}"
         elif fam.startswith("dynamo_component_"):
-            short = fam[len("dynamo_component_"):]
-            assert short in agg, f"dashboard references unforwarded {fam}"
+            assert fam in component_fams, f"dashboard references unforwarded {fam}"
         else:
             raise AssertionError(f"unknown metric prefix: {fam}")
+
+
+def test_dashboard_counters_use_rate_friendly_names():
+    """Every ``*_total`` family the dashboard rates must be a COUNTER_KEYS
+    export (a Gauge under a ``_total`` name breaks PromQL rate())."""
+    with open(os.path.join(REPO, "deploy", "grafana", "dynamo_tpu_serving.json")) as f:
+        dash = json.load(f)
+    rated = set()
+    for p in dash["panels"]:
+        for t in p["targets"]:
+            for m in re.findall(r"(?:rate|increase)\((dynamo_component_[a-z_]+_total)\b", t["expr"]):
+                rated.add(m)
+    assert rated, "dashboard should rate() at least one worker counter"
+    counter_fams = {f"dynamo_component_worker_{k}" for k in COUNTER_KEYS}
+    for fam in rated:
+        assert fam in counter_fams, f"{fam} is rate()d but not exported as a Counter"

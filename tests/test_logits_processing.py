@@ -10,6 +10,7 @@ from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.engine.scheduler import SchedulerConfig, StopConditions
 from dynamo_tpu.logits_processing import (
     AllowedTokensProcessor,
+    LogitBiasProcessor,
     MinPProcessor,
     RepetitionPenaltyProcessor,
     TemperatureProcessor,
@@ -31,6 +32,52 @@ def test_allowed_tokens_masks_everything_else():
     out = np.asarray(AllowedTokensProcessor(allowed=[3, 7])([], logits))
     kept = np.isfinite(out)
     assert kept[3] and kept[7] and kept.sum() == 2
+
+
+def test_logit_bias_processor():
+    logits = jnp.zeros((8,))
+    out = np.asarray(LogitBiasProcessor({3: 5.0, "5": -2.0})([], logits))
+    assert out[3] == 5.0 and out[5] == -2.0
+    assert out[0] == 0.0 and out[7] == 0.0
+    # Out-of-vocab ids are ignored, not an index error.
+    out = np.asarray(LogitBiasProcessor({99: 5.0})([], logits))
+    assert (out == 0.0).all()
+
+
+async def test_engine_logit_bias_steers_greedy_decode():
+    """OpenAI logit_bias via sampling_options: +100 forces the biased token
+    under greedy decode; −100 bans the otherwise-argmax tokens."""
+    import asyncio
+
+    from dynamo_tpu.runtime.engine import Context
+
+    engine = TpuEngine.build(
+        EngineArgs(
+            model="tiny", dtype="float32",
+            scheduler=SchedulerConfig(num_blocks=64, prefill_buckets=[16, 32, 64],
+                                      decode_buckets=[1, 2, 4]),
+        )
+    )
+
+    async def run(bias):
+        so = {"temperature": 0}
+        if bias is not None:
+            so["logit_bias"] = bias
+        req = {"token_ids": list(range(10)), "sampling_options": so,
+               "stop_conditions": {"max_tokens": 4, "ignore_eos": True}}
+        toks = []
+        async for frame in engine.generate(req, Context()):
+            toks += frame["token_ids"]
+        return toks
+
+    try:
+        plain = await run(None)
+        forced = await run({7: 100.0})
+        assert forced == [7, 7, 7, 7], forced
+        banned = await run({t: -100.0 for t in set(plain)})
+        assert not (set(banned) & set(plain)), (plain, banned)
+    finally:
+        await engine.stop()
 
 
 def test_min_p():

@@ -1,0 +1,135 @@
+"""Metrics hygiene: instantiate every registry the serving roles create,
+render them, and assert the exposition obeys the conventions Prometheus
+tooling relies on — unique family names, counters ending in ``_total``,
+histograms with explicitly declared (non-default) buckets — plus the
+regression test for the ``_get_or_create`` label-mismatch trap."""
+
+import re
+
+import pytest
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.metrics_aggregator import COUNTER_KEYS, GAUGE_KEYS, MetricsAggregator
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+# prometheus_client's implicit default buckets: a histogram rendering these
+# exact bounds almost certainly forgot to declare LLM-scale buckets.
+_DEFAULT_LE = {
+    "0.005", "0.01", "0.025", "0.05", "0.075", "0.1", "0.25", "0.5",
+    "0.75", "1.0", "2.5", "5.0", "7.5", "10.0", "+Inf",
+}
+
+
+def parse_families(text: str):
+    """{family_name: {"type": t, "samples": [...], "le": set()}} from the
+    Prometheus text exposition."""
+    fams = {}
+    for line in text.splitlines():
+        m = re.match(r"# TYPE (\S+) (\S+)", line)
+        if m:
+            fams[m.group(1)] = {"type": m.group(2), "samples": [], "le": set()}
+            continue
+        if line.startswith("#") or not line.strip():
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        fam_name = next((f for f in fams if name == f or name.startswith(f + "_")), None)
+        if fam_name:
+            fams[fam_name]["samples"].append(name)
+            le = re.search(r'le="([^"]+)"', line)
+            if le:
+                fams[fam_name]["le"].add(le.group(1))
+    return fams
+
+
+def frontend_registry() -> MetricsRegistry:
+    """HttpService's registry with every metric factory touched (the way a
+    live frontend would after serving traffic)."""
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    model = "hygiene-model"
+    service._m_requests(model, "200").inc()
+    service._m_inflight(model).set(1)
+    service._m_ttft(model).observe(0.1)
+    service._m_itl(model).observe(0.01)
+    service._m_duration(model).observe(0.5)
+    service._m_queue(model).observe(0.02)
+    service._m_output_tokens(model).inc(10)
+    service._m_input_tokens(model).inc(20)
+    return service.metrics
+
+
+def aggregator_registry() -> MetricsRegistry:
+    """MetricsAggregator's registry fed one full scrape covering every
+    gauge and counter key a worker can report."""
+    agg = MetricsAggregator(drt=None, namespace="ns", component="backend", endpoint="generate")
+    stats = {0xA: {key: 1.0 for key in GAUGE_KEYS + COUNTER_KEYS}}
+    agg.export_stats(stats)
+    agg.export_stats(stats)  # second scrape exercises the delta path
+    return agg.registry
+
+
+@pytest.mark.parametrize("make_registry", [frontend_registry, aggregator_registry],
+                         ids=["frontend", "aggregator"])
+def test_registry_hygiene(make_registry):
+    registry = make_registry()
+    text = registry.render().decode()
+    fams = parse_families(text)
+    assert fams, "registry rendered no metric families"
+
+    # No duplicate family names (TYPE declared once per family).
+    names = re.findall(r"# TYPE (\S+) ", text)
+    assert len(names) == len(set(names)), f"duplicate families: {sorted(names)}"
+
+    for name, fam in fams.items():
+        # Counters must expose rate()-able *_total samples.
+        if fam["type"] == "counter":
+            totals = [s for s in fam["samples"] if s.endswith("_total")]
+            assert totals, f"counter {name} renders no _total sample"
+        # Histograms must declare buckets explicitly — the prometheus_client
+        # defaults are request-latency-shaped for generic web apps, not for
+        # TTFT/ITL/step-time scales.
+        if fam["type"] == "histogram":
+            assert fam["le"], f"histogram {name} has no buckets"
+            assert fam["le"] != _DEFAULT_LE, (
+                f"histogram {name} uses prometheus_client default buckets; "
+                "declare buckets= explicitly"
+            )
+
+
+def test_monotonic_worker_stats_export_as_counters():
+    """Satellite regression: ``*_total`` worker stats must not be exported
+    as Gauges (breaks PromQL rate())."""
+    text = aggregator_registry().render().decode()
+    fams = parse_families(text)
+    for key in COUNTER_KEYS:
+        # The classic text format renders counter families WITH the _total
+        # suffix, whatever the declared name was.
+        fam_name = f"dynamo_component_worker_{key}"
+        if not fam_name.endswith("_total"):
+            fam_name += "_total"
+        assert fams.get(fam_name, {}).get("type") == "counter", (
+            f"{key} must export as a Counter, got {fams.get(fam_name)}"
+        )
+
+
+def test_counter_delta_and_restart_semantics():
+    agg = MetricsAggregator(drt=None, namespace="ns", component="backend", endpoint="generate")
+    agg.export_stats({1: {"mixed_steps_total": 10}})
+    agg.export_stats({1: {"mixed_steps_total": 14}})   # +4
+    agg.export_stats({1: {"mixed_steps_total": 3}})    # restart → +3
+    text = agg.registry.render().decode()
+    line = next(l for l in text.splitlines()
+                if l.startswith("dynamo_component_worker_mixed_steps_total{"))
+    assert line.endswith(" 17.0"), line
+
+
+def test_get_or_create_rejects_label_mismatch_on_reuse():
+    """Regression: sibling registries reusing a collector with a DIFFERENT
+    label set must get a clear error at declaration time, not a confusing
+    .labels() blow-up (or silent mis-labelling) later."""
+    root = MetricsRegistry()
+    root.child(worker="a").gauge("shared_metric", "doc").set(1)
+    with pytest.raises(ValueError, match="already registered with labels"):
+        root.child(zone="b").gauge("shared_metric", "doc")
+    # Same label set from another sibling still reuses cleanly.
+    root.child(worker="b").gauge("shared_metric", "doc").set(2)

@@ -32,10 +32,44 @@ def chat_body(**kw):
     (chat_body(top_logprobs=5), "top_logprobs requires"),
     (chat_body(logprobs=True, top_logprobs=5), "top_logprobs > 0 is not supported"),
     (chat_body(temperature=9.0), "temperature must be in"),
+    (chat_body(logit_bias=[1, 2]), "logit_bias must be an object"),
+    (chat_body(logit_bias={"abc": 1}), "logit_bias keys must be token ids"),
+    (chat_body(logit_bias={"5": 200}), "logit_bias values must be numbers in"),
 ])
 def test_chat_validation_errors(body, frag):
     with pytest.raises(oai.RequestError, match=frag):
         oai.validate_chat_request(body)
+
+
+def test_logit_bias_accepted_and_normalized():
+    body = chat_body(logit_bias={"122": 50, 7: -1.5})
+    assert oai.validate_chat_request(body) is body
+    assert oai.sampling_from_request(body)["logit_bias"] == {122: 50.0, 7: -1.5}
+    # Completions share the validation path.
+    ok = {"model": "m", "prompt": "hi", "logit_bias": {"3": -100}}
+    assert oai.validate_completion_request(ok) is ok
+
+
+async def test_logit_bias_steers_greedy_decode_http():
+    """VERDICT missing #2: logit_bias flows protocol → preprocessor →
+    engine and is applied pre-sampling — +100 on one byte token forces a
+    greedy completion of exactly that byte."""
+    service, engine = await make_local_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = chat_body(
+                temperature=0, max_tokens=4,
+                logit_bias={str(ord("z")): 100},
+            )
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body
+            ) as r:
+                assert r.status == 200, await r.text()
+                content = (await r.json())["choices"][0]["message"]["content"]
+                assert content == "zzzz", content
+    finally:
+        await service.stop()
+        await engine.stop()
 
 
 def test_completion_validation():

@@ -1,0 +1,207 @@
+"""End-to-end request tracing: one HTTP request through the demo stack
+(frontend → push_router → worker wire path → TpuEngine scheduler) must yield
+ONE trace id spanning frontend/worker/scheduler records in the JSONL export,
+plus a valid Chrome-trace conversion; and the engine flight recorder's XLA
+compile tracker must report 0 post-warmup compiles in steady state and >0
+with warmup disabled."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.entrypoint import build_routed_pipeline, register_llm
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.http.service import TRACE_ID_HEADER, HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import PushRouter
+from dynamo_tpu.runtime.tracing import (
+    chrome_trace,
+    configure_tracing,
+    get_tracer,
+    read_trace_file,
+)
+
+MODEL = "tiny-traced"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def trace_file(tmp_path):
+    """Point the process tracer at a fresh JSONL file; restore the disabled
+    tracer afterwards so other tests see zero overhead."""
+    path = str(tmp_path / "trace.jsonl")
+    configure_tracing(path=path, sample=1.0, service="test")
+    yield path
+    configure_tracing(path=None, sample=0.0)
+
+
+def tiny_engine(warmup_ctx=0) -> TpuEngine:
+    return TpuEngine.build(
+        EngineArgs(
+            model="tiny",
+            dtype="float32",
+            eos_token_ids=[0],
+            scheduler=SchedulerConfig(
+                num_blocks=64, prefill_buckets=[16, 32, 64], decode_buckets=[1, 2, 4]
+            ),
+            warmup_ctx=warmup_ctx,
+        )
+    )
+
+
+async def test_single_trace_through_demo_stack(trace_file, tmp_path):
+    """frontend → router (wire path) → worker → scheduler: every record in
+    the export carries the caller's trace id."""
+    drt = await DistributedRuntime.detached()
+    engine = tiny_engine()
+    service = None
+    try:
+        ep = drt.namespace("tracetest").component("backend").endpoint("generate")
+        card = ModelDeploymentCard(name=MODEL, model_type="chat")
+        handle, _ = await register_llm(drt, ep, engine, card, stats_handler=engine.stats_handler)
+        # Force the real wire path (pub/sub + TCP call-home): the in-process
+        # fast path would skip the worker ingress span.
+        drt.local_engines.pop(handle.instance.instance_id)
+
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        manager = ModelManager()
+        pipeline = build_routed_pipeline(ByteTokenizer(), PushRouter(client), card)
+        manager.add_model("chat", MODEL, pipeline)
+        service = HttpService(manager, host="127.0.0.1", port=0)
+        await service.start()
+
+        trace_id = "ab" * 16
+        headers = {"traceparent": f"00-{trace_id}-{'cd' * 8}-01"}
+        body = {
+            "model": MODEL,
+            "messages": [{"role": "user", "content": "trace me"}],
+            "max_tokens": 4,
+            "temperature": 0,
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json=body, headers=headers,
+            ) as r:
+                assert r.status == 200, await r.text()
+                # The trace id is echoed on the response.
+                assert r.headers[TRACE_ID_HEADER] == trace_id
+                await r.json()
+    finally:
+        if service is not None:
+            await service.stop()
+        await engine.stop()
+        await drt.shutdown()
+
+    get_tracer().flush()
+    records = read_trace_file(trace_file)
+    assert records, "no trace records exported"
+    assert {rec["trace_id"] for rec in records} == {trace_id}, "trace id fragmented"
+
+    by_service = {}
+    for rec in records:
+        by_service.setdefault(rec["service"], set()).add(rec["name"])
+    assert "http_request" in by_service.get("frontend", set())
+    assert "route" in by_service.get("frontend", set())
+    assert "worker_handle" in by_service.get("worker", set())
+    sched = by_service.get("scheduler", set())
+    for name in ("queued", "admitted", "first_token", "finish"):
+        assert name in sched, f"missing scheduler event {name}: {sched}"
+
+    # Parenting: the worker span's parent is a frontend span of this trace.
+    spans = {r["span_id"]: r for r in records if r["kind"] == "span"}
+    worker = next(r for r in records if r["name"] == "worker_handle")
+    assert worker["parent_id"] in spans
+    assert spans[worker["parent_id"]]["service"] == "frontend"
+
+    # Chrome-trace conversion is structurally valid and covers all records.
+    ct = chrome_trace(records)
+    assert ct["traceEvents"]
+    phases = {e["ph"] for e in ct["traceEvents"]}
+    assert "X" in phases and "i" in phases
+    json.dumps(ct)  # serializable
+
+    # The CLI renders both views without error.
+    out = str(tmp_path / "chrome.json")
+    for argv in (
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"), trace_file],
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"), trace_file,
+         "-t", trace_id],
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"), trace_file,
+         "--chrome", out],
+    ):
+        proc = subprocess.run(argv, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+    assert json.load(open(out))["traceEvents"]
+
+
+async def test_unsampled_requests_export_nothing(trace_file):
+    """sample=0 keeps ids flowing (header echo) but exports no records."""
+    configure_tracing(path=trace_file, sample=0.0)
+    engine = tiny_engine()
+    try:
+        req = {"token_ids": list(range(12)), "sampling_options": {"temperature": 0},
+               "stop_conditions": {"max_tokens": 3}}
+        async for _ in engine.generate(req, Context()):
+            pass
+    finally:
+        await engine.stop()
+    get_tracer().flush()
+    assert not os.path.exists(trace_file) or not read_trace_file(trace_file)
+
+
+def test_deterministic_sampling_decision(trace_file):
+    """The keep/drop decision is a pure function of the trace id — the
+    property that makes one request one trace across processes."""
+    tracer = configure_tracing(path=trace_file, sample=0.5)
+    ids = [f"{i:032x}" for i in range(1, 200)]
+    first = [tracer.sampled(t) for t in ids]
+    assert [tracer.sampled(t) for t in ids] == first
+    assert any(first) and not all(first), "0.5 sampling should split the ids"
+
+
+async def test_compile_tracker_steady_state_vs_cold(trace_file):
+    """Warmed engine: serving traffic compiles nothing new. Cold engine:
+    the same traffic shows up in compiles_after_warmup_total — PR 1's
+    mid-traffic compile killer, now a counter."""
+
+    async def run_traffic(engine):
+        for start in (0, 40):  # two requests, same shapes second time
+            req = {"token_ids": list(range(start, start + 20)),
+                   "sampling_options": {"temperature": 0},
+                   "stop_conditions": {"max_tokens": 4}}
+            async for _ in engine.generate(req, Context()):
+                pass
+
+    warmed = tiny_engine(warmup_ctx=64)
+    try:
+        await run_traffic(warmed)
+        stats = warmed.stats_handler()
+        assert stats["compiles_after_warmup_total"] == 0, (
+            f"steady state compiled: {warmed.scheduler.flight.post_warmup_keys}"
+        )
+        assert stats["compiles_total"] > 0
+        assert stats["step_decode_steps_total"] > 0
+        assert stats["step_prefill_steps_total"] > 0
+    finally:
+        await warmed.stop()
+
+    cold = tiny_engine(warmup_ctx=0)
+    try:
+        await run_traffic(cold)
+        stats = cold.stats_handler()
+        assert stats["compiles_after_warmup_total"] > 0
+        assert cold.scheduler.flight.post_warmup_keys  # shape keys recorded
+    finally:
+        await cold.stop()

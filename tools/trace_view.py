@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Render request traces from a JSONL span export.
+
+The serving stack (frontend/worker ``--trace-file``, or ``DYN_TRACE_FILE``)
+writes one JSON record per span/event; this tool turns them into a
+per-request timeline — the "where did this request's 242 ms go" view — or a
+Chrome-trace file for chrome://tracing / Perfetto.
+
+Usage::
+
+    python tools/trace_view.py trace.jsonl                 # list traces
+    python tools/trace_view.py trace.jsonl -t <trace_id>   # one timeline
+    python tools/trace_view.py trace.jsonl --all           # every timeline
+    python tools/trace_view.py trace.jsonl --chrome out.json
+
+Multiple input files merge (frontend + worker processes each write their
+own file; records carry the trace id, so merging is a concat).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+from dynamo_tpu.runtime.tracing import chrome_trace, read_trace_file
+
+BAR_WIDTH = 40
+
+
+def group_by_trace(records: List[dict]) -> Dict[str, List[dict]]:
+    traces: Dict[str, List[dict]] = defaultdict(list)
+    for rec in records:
+        if rec.get("kind") in ("span", "event") and rec.get("trace_id"):
+            traces[rec["trace_id"]].append(rec)
+    for recs in traces.values():
+        recs.sort(key=lambda r: r.get("ts") or 0.0)
+    return traces
+
+
+def trace_summary(trace_id: str, recs: List[dict]) -> str:
+    t0 = min(r["ts"] for r in recs)
+    t1 = max(r["ts"] + (r.get("dur_s") or 0.0) for r in recs)
+    services = sorted({r.get("service") or "?" for r in recs})
+    return (
+        f"{trace_id}  {len(recs):3d} records  {1000 * (t1 - t0):8.1f} ms  "
+        f"[{', '.join(services)}]"
+    )
+
+
+def render_timeline(trace_id: str, recs: List[dict], out=sys.stdout) -> None:
+    t0 = min(r["ts"] for r in recs)
+    t1 = max(r["ts"] + (r.get("dur_s") or 0.0) for r in recs)
+    total = max(t1 - t0, 1e-9)
+    out.write(f"trace {trace_id}  ({1000 * total:.1f} ms total)\n")
+    for rec in recs:
+        off = rec["ts"] - t0
+        dur = rec.get("dur_s") or 0.0
+        lo = int(BAR_WIDTH * off / total)
+        hi = max(lo + 1, int(BAR_WIDTH * (off + dur) / total)) if dur else lo + 1
+        bar = " " * lo + ("█" * (hi - lo) if rec["kind"] == "span" else "·")
+        bar = bar[:BAR_WIDTH].ljust(BAR_WIDTH)
+        label = f"{rec.get('service') or '?':>10}  {rec.get('name') or '?':<16}"
+        timing = f"+{1000 * off:8.2f} ms"
+        timing += f"  {1000 * dur:8.2f} ms" if dur else " " * 12
+        attrs = rec.get("attrs") or {}
+        detail = " ".join(f"{k}={v}" for k, v in attrs.items() if k != "request_id")
+        out.write(f"  |{bar}| {label} {timing}  {detail}\n")
+        for ev in rec.get("events") or []:
+            eoff = (ev.get("ts") or rec["ts"]) - t0
+            out.write(f"  |{' ' * BAR_WIDTH}|   {'':>8}· {ev.get('name')} +{1000 * eoff:.2f} ms\n")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description="dynamo-tpu trace viewer")
+    p.add_argument("files", nargs="+", help="JSONL trace files (merged)")
+    p.add_argument("-t", "--trace-id", default=None, help="render one trace's timeline")
+    p.add_argument("--all", action="store_true", help="render every trace's timeline")
+    p.add_argument("--chrome", default=None, metavar="OUT",
+                   help="write a Chrome-trace/Perfetto JSON file")
+    args = p.parse_args()
+
+    records: List[dict] = []
+    for path in args.files:
+        records.extend(read_trace_file(path))
+    traces = group_by_trace(records)
+    if not traces:
+        print("no trace records found", file=sys.stderr)
+        return 1
+
+    if args.chrome:
+        selected = records if args.trace_id is None else traces.get(args.trace_id, [])
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(selected), f)
+        print(f"wrote {args.chrome} ({len(selected)} records)")
+        return 0
+
+    if args.trace_id:
+        recs = traces.get(args.trace_id)
+        if not recs:
+            print(f"trace {args.trace_id} not found", file=sys.stderr)
+            return 1
+        render_timeline(args.trace_id, recs)
+        return 0
+
+    if args.all:
+        for tid, recs in sorted(traces.items(), key=lambda kv: kv[1][0]["ts"]):
+            render_timeline(tid, recs)
+            print()
+        return 0
+
+    for tid, recs in sorted(traces.items(), key=lambda kv: kv[1][0]["ts"]):
+        print(trace_summary(tid, recs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
