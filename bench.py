@@ -563,6 +563,116 @@ def bench_observability_overhead():
     }
 
 
+def bench_guided_overhead():
+    """Guided decoding cost at the scheduler: steady greedy decode
+    throughput with every row unmasked vs every row grammar-masked
+    (the fused mask-gather+sample dispatch + the host-side FSM advance).
+    Interleaved best-of-N on one long-lived scheduler, same discipline as
+    observability_overhead. Budget: ≤5% per-step decode overhead. Also
+    reports grammar→token-FSM compile latency for a realistic tool schema
+    (the per-first-request cost the LRU cache amortizes away)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+    from dynamo_tpu.llm.guided.processor import GuidedDecoder
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    cfg = get_config("tiny").replace(max_seq_len=4096)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rounds = 3
+    # Never-accepting within the run (500+ chars required, 80 emitted), so
+    # masked rows decode the full budget — pure steady-state mask cost.
+    pattern = "[ab]{500,}"
+    spec = {"kind": "regex", "pattern": pattern}
+
+    sched = Scheduler(cfg, params, SchedulerConfig(
+        num_blocks=768, max_running=8,
+        prefill_buckets=[32, 64, 128], decode_buckets=[1, 2, 4, 8],
+        num_scheduler_steps=1, enable_prefix_caching=False,
+        guided_pool_rows=1024,
+    ), dtype=jnp.float32)
+    sched.attach_guided(ByteTokenizer())
+
+    phase_counter = [0]
+
+    def measure(guided: bool) -> float:
+        """Steady-state decode-step throughput from the flight recorder's
+        decode-phase histogram: admit all 8 rows first, then measure only
+        full-batch decode steps. The subject is the per-STEP cost of the
+        fused mask-gather+sample dispatch plus the host FSM advance —
+        admission structure (guided rows are wave-ineligible by design)
+        and batch ramp-down tails are excluded from both phases alike."""
+        phase_counter[0] += 1
+        p = phase_counter[0]
+        for i in range(8):
+            sched.add_request(
+                f"p{p}r{i}", list(range(1 + (p + i) % 8, 33 + (p + i) % 8)),
+                SamplingParams(temperature=0.0), StopConditions(max_tokens=200),
+                guided=spec if guided else None,
+            )
+        while sched.waiting:
+            sched.step()
+        h = sched.flight._hists["decode"]
+        t_before, n_before = h.sum_s, h.tokens
+        while len(sched.running) == 8 and sched.has_work():
+            sched.step()
+        tok_s = (h.tokens - n_before) / max(h.sum_s - t_before, 1e-9)
+        while sched.has_work():  # drain the tail unmeasured
+            sched.step()
+        return tok_s
+
+    measure(False)  # executable warmup (admission wave + decode)
+    measure(True)   # guided-sampler + grammar warmup
+    best_off = best_on = 0.0
+    for _ in range(rounds):
+        best_off = max(best_off, measure(False))
+        best_on = max(best_on, measure(True))
+
+    # Grammar→token-FSM compile latency for a realistic tool schema (fresh
+    # decoder: no LRU hit), plus the cached re-open cost.
+    tool_schema = {
+        "type": "object",
+        "properties": {
+            "location": {"type": "string", "maxLength": 64},
+            "unit": {"enum": ["celsius", "fahrenheit"]},
+            "days": {"type": "integer"},
+            "include_hourly": {"type": "boolean"},
+        },
+    }
+    from dynamo_tpu.llm.guided.grammar import schema_to_regex
+
+    tool_spec = {"kind": "regex", "pattern": schema_to_regex(tool_schema)}
+    dec = GuidedDecoder(ByteTokenizer(), eos_ids=[0], vocab_size=cfg.vocab_size)
+    t0 = time.perf_counter()
+    st = dec.open(tool_spec)
+    compile_ms = (time.perf_counter() - t0) * 1000.0
+    t0 = time.perf_counter()
+    dec.open(tool_spec)
+    cached_ms = (time.perf_counter() - t0) * 1000.0
+
+    overhead_pct = round(100.0 * (best_off - best_on) / max(best_off, 1e-9), 2)
+    return {
+        "unguided": {"tok_s": round(best_off, 1), "rounds": rounds},
+        "guided": {"tok_s": round(best_on, 1), "rounds": rounds,
+                   "fsm_states": sched.guided.pool._used - 1},
+        "overhead_pct": overhead_pct,
+        "budget_pct": 5.0,
+        "within_budget": overhead_pct <= 5.0,
+        "grammar_compile": {
+            "tool_schema_ms": round(compile_ms, 2),
+            "cached_open_ms": round(cached_ms, 3),
+            "fsm_states": st.fsm.num_states,
+        },
+        "note": "tiny model on CPU, byte tokenizer, every row masked — the "
+                "worst case; real batches mix guided/unguided rows through "
+                "the same executable",
+    }
+
+
 # --------------------------------------------------------------------------
 # child: run sections against the already-chosen backend, emit partials
 # --------------------------------------------------------------------------
@@ -914,14 +1024,34 @@ def child_main() -> None:
     else:
         errors.append("observability skipped: budget")
 
+    # --- guided decoding overhead (masked vs unmasked, CPU subprocess) ------
+    guided_overhead = None
+    if remaining() > 45:
+        try:
+            guided_overhead, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "overhead_pct",
+                max(45, remaining() - 10), extra_env={"BENCH_GUIDED_ONLY": "1"},
+            )
+            if guided_overhead is None:
+                errors.append(f"guided_overhead: {err}")
+            else:
+                _emit_partial("guided_overhead", guided_overhead)
+        except subprocess.TimeoutExpired:
+            errors.append("guided_overhead: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"guided_overhead: {type(e).__name__}: {e}")
+    else:
+        errors.append("guided_overhead skipped: budget")
+
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
                               cpu_fallback, errors, tpu_http=tpu_http,
                               router_prefix=router_prefix, large_model=large_detail,
                               mixed_admission=mixed_admission,
-                              observability=observability)), flush=True)
+                              observability=observability,
+                              guided_overhead=guided_overhead)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -948,6 +1078,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "large_model": large_model,
             "mixed_admission": mixed_admission,
             "observability": observability,
+            "guided_overhead": guided_overhead,
             "device": device,
             "cpu_fallback": cpu_fallback,
             "errors": errors,
@@ -1067,6 +1198,7 @@ def main() -> None:
             large_model=partials.get("large_model"),
             mixed_admission=partials.get("mixed_admission"),
             observability=partials.get("observability"),
+            guided_overhead=partials.get("guided_overhead"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
     final["detail"]["wall_s"] = round(time.time() - t_start, 1)
@@ -1081,6 +1213,13 @@ if __name__ == "__main__":
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_mixed_admission()), flush=True)
+    elif os.environ.get("BENCH_GUIDED_ONLY") == "1":
+        # CPU-pinned: measures the mask-gather + FSM-advance cost in the
+        # scheduler step loop, not the device tunnel.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_guided_overhead()), flush=True)
     elif os.environ.get("BENCH_OBS_ONLY") == "1":
         # CPU-pinned: measures the tracing layer's host-side cost, which a
         # device tunnel's dispatch latency would drown out.

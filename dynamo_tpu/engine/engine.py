@@ -73,6 +73,11 @@ class EngineArgs:
     # new (batch bucket × table width) shape compiles mid-request — measured
     # as the dominant serving-plane latency on fresh processes.
     warmup_ctx: int = 0
+    # Guided decoding (structured outputs) needs the SERVED tokenizer to
+    # lift grammars to token-level FSMs (llm/guided). Attached before
+    # warmup so the masked-sampling executables precompile; without it,
+    # guided requests are rejected engine-side.
+    tokenizer: Optional[Any] = None
 
 
 class TpuEngine:
@@ -156,6 +161,8 @@ class TpuEngine:
                         dc, jax.random.PRNGKey(args.seed + 1), dtype=dtype
                     )
             engine.scheduler.attach_draft(dc, draft_params, gamma=args.spec_gamma)
+        if args.tokenizer is not None:
+            engine.scheduler.attach_guided(args.tokenizer)
         if args.warmup_ctx > 0:
             n = engine.scheduler.warmup(args.warmup_ctx)
             logger.info("warmed %d executables (ctx %d)", n, args.warmup_ctx)
@@ -268,6 +275,11 @@ class TpuEngine:
             "keep_blocks_on_finish": bool(disagg.get("do_remote_decode")),
             "prefilled": request.get("_prefilled"),
         }
+        guided = request.get("guided_decoding")
+        if guided is not None:
+            # Grammar-constrained decoding (llm/guided): the scheduler
+            # compiles/caches the token FSM and masks sampling device-side.
+            extras["guided"] = guided
         mm = request.get("multimodal")
         if mm is not None:
             from dynamo_tpu.llm.multimodal import features_from_wire
@@ -396,4 +408,13 @@ class TpuEngine:
         # tracker (compiles_after_warmup_total > 0 in steady state is the
         # alert that shapes are compiling mid-traffic — PR 1's silent killer).
         stats.update(self.scheduler.flight.to_stats())
+        # Guided decoding: request + grammar-compile counters (scrape-
+        # visible so dashboards can watch structured-output traffic).
+        if self.scheduler.guided is not None:
+            stats.update(self.scheduler.guided.stats())
         return stats
+
+    def attach_guided_tokenizer(self, tokenizer) -> None:
+        """Enable guided decoding post-build (pipeline assembly attaches the
+        serving tokenizer here when EngineArgs.tokenizer wasn't set)."""
+        self.scheduler.attach_guided(tokenizer)

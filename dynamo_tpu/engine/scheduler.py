@@ -42,7 +42,7 @@ from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.flight_recorder import FlightRecorder, StepTimer
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays, KvEvent, OutOfBlocksError
 from dynamo_tpu.engine.models import llama
-from dynamo_tpu.engine.sampling import SamplingParams, sample_batch
+from dynamo_tpu.engine.sampling import SamplingParams, guided_sample_batch, sample_batch
 from dynamo_tpu.llm.tokens import extend_block_hashes
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import get_tracer
@@ -156,6 +156,10 @@ class Sequence:
     # Request tracing: (trace_id, parent_span_id) when this request's trace
     # is sampled; None keeps the scheduler's trace path one branch.
     trace: Optional[tuple] = None
+    # Guided decoding: per-sequence token-FSM cursor (llm/guided
+    # GuidedState). The scheduler advances it host-side from each sampled
+    # token and masks logits device-side via the shared mask pool.
+    guided: Optional[object] = None
 
     @property
     def all_ids(self) -> List[int]:
@@ -221,6 +225,12 @@ class SchedulerConfig:
     # its blocks, re-prefill it later) instead of finishing the starved
     # sequence with "length" (ref: vLLM recompute preemption).
     enable_preemption: bool = True
+    # Guided decoding: initial device mask-pool capacity in FSM-state rows.
+    # The masked-sampling executable's shape is (decode_bucket, pool_rows);
+    # warmup() precompiles it at this capacity, so as long as the total
+    # states of live grammars fit, guided rows add no post-warmup compiles.
+    # Overflow doubles the pool (pow2 buckets, one recompile, logged).
+    guided_pool_rows: int = 1024
 
 
 @dataclass
@@ -376,6 +386,10 @@ class Scheduler:
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)
+        # Guided decoding (attach_guided): grammar compiler + device mask
+        # pool. One fused mask+sample executable serves every guided batch.
+        self.guided = None
+        self._guided_sample_jit = jax.jit(guided_sample_batch)
         self.dtype = dtype
         self._mm_jit = None  # lazy: multimodal prefill variant
         # Speculative decoding (attach_draft): draft model + stats.
@@ -494,6 +508,20 @@ class Scheduler:
                 donate_argnums=(1, 2),
             )
 
+    def attach_guided(self, tokenizer) -> None:
+        """Enable grammar-constrained decoding: grammars lift to token FSMs
+        against this tokenizer's vocabulary (llm/guided). Attach BEFORE
+        warmup() so the masked-sampling executables precompile at the
+        initial pool bucket."""
+        from dynamo_tpu.llm.guided.processor import GuidedDecoder
+
+        self.guided = GuidedDecoder(
+            tokenizer,
+            eos_ids=self._eos,
+            vocab_size=self.mc.vocab_size,
+            pool_rows=self.sc.guided_pool_rows,
+        )
+
     # --- public API (called from event loop) --------------------------------
     def add_request(
         self,
@@ -506,9 +534,15 @@ class Scheduler:
         prefilled: Optional[dict] = None,
         mm_features: Optional[np.ndarray] = None,
         trace: Optional[tuple] = None,
+        guided: Optional[dict] = None,
     ) -> Sequence:
         if not token_ids:
             raise ValueError("empty prompt")
+        if guided is not None and self.guided is None:
+            raise ValueError(
+                "guided decoding requested but no tokenizer is attached "
+                "(Scheduler.attach_guided / EngineArgs.tokenizer)"
+            )
         if len(token_ids) >= self.mc.max_seq_len:
             raise ValueError(f"prompt length {len(token_ids)} >= max_seq_len {self.mc.max_seq_len}")
         if mm_features is not None:
@@ -527,10 +561,19 @@ class Scheduler:
             mm_features=mm_features,
             trace=trace,
         )
+        if guided is not None:
+            seq.guided = self.guided.open(guided)  # ValueError on a bad spec
         self.waiting.append(seq)
         self.by_id[request_id] = seq
         self.request_total += 1
         self._trace_event(seq, "queued", prompt_tokens=len(token_ids))
+        if seq.guided is not None:
+            self._trace_event(
+                seq, "guided_mask",
+                states=seq.guided.fsm.num_states,
+                compile_s=round(seq.guided.fsm.compile_s, 6),
+                cached=seq.guided.from_cache,
+            )
         return seq
 
     def abort(self, request_id: str) -> None:
@@ -830,6 +873,7 @@ class Scheduler:
             and seq.prefilled is None
             and seq.resume_tokens is None
             and seq.mm_features is None
+            and seq.guided is None  # wave samples on device, unmasked
             and not s.logprobs
             and not s.logits_processors
             and not (s.seed is not None and s.temperature > 0)
@@ -1136,6 +1180,21 @@ class Scheduler:
                 jnp.ones((bucket,), jnp.float32), key, None,
             )
             count += 1
+        # Guided masked-sampling executables: one per decode bucket (plus
+        # the bucket-1 prefill-tail sampler) at the current pool capacity —
+        # guided rows joining a warmed batch then compile nothing.
+        if self.guided is not None:
+            pool = self.guided.pool.device()
+            P = int(pool.shape[0])
+            for bucket in sorted(set(self.sc.decode_buckets) | {1}):
+                self.flight.record_exec("guided_sample", (bucket, P))
+                self._guided_sample_jit(
+                    jnp.zeros((bucket, self.mc.vocab_size), jnp.float32), pool,
+                    jnp.zeros((2, bucket), jnp.int32),
+                    jnp.zeros((bucket,), jnp.float32),
+                    jnp.ones((bucket,), jnp.float32), key, None,
+                )
+                count += 1
         prev_bucket = 0
         for bucket in self.sc.prefill_buckets:
             if bucket > self.sc.max_prefill_chunk:
@@ -1278,6 +1337,10 @@ class Scheduler:
                 or seq.sampling.logprobs
                 or seq.sampling.has_penalties
                 or seq.mm_features is not None
+                # Guided rows can't ride speculation (proposal sampling
+                # ignores the FSM mask): the batch gracefully falls back to
+                # the non-spec single-step path below.
+                or seq.guided is not None
                 # Seeded sampling needs per-row keys the spec round doesn't
                 # thread; greedy seeded rows are fine (seed is a no-op).
                 or (seq.sampling.seed is not None and seq.sampling.temperature > 0)
@@ -1294,6 +1357,9 @@ class Scheduler:
                 seq.sampling.logits_processors
                 or seq.sampling.logprobs
                 or seq.sampling.has_penalties  # history changes within the window
+                # FSM state advances host-side per token — windows would
+                # sample N tokens device-side without mask updates.
+                or seq.guided is not None
                 or (seq.sampling.seed is not None and seq.sampling.temperature > 0)
                 for seq in batch
             )
@@ -1352,18 +1418,21 @@ class Scheduler:
         if any(seq.sampling.has_penalties for seq in batch):
             logits = self._apply_penalties(batch, bucket, logits)
         # Per-request logits processors (dynamo_tpu.logits_processing): the
-        # host path — one device→host sync for the rows that opted in, so
-        # processor-free batches stay on the fast path.
+        # host path — ONLY the rows that carry processors cross to host
+        # (device gather → [n_proc, V] transfer → device scatter), so one
+        # logit_bias row no longer drags the whole batch's [B, V] logits
+        # over the wire, and processor-free batches stay on the fast path.
         if any(seq.sampling.logits_processors for seq in batch):
             from dynamo_tpu.logits_processing import apply_chain
 
-            rows = np.array(logits)  # writable host copy
-            for i, seq in enumerate(batch):
-                if seq.sampling.logits_processors:
-                    rows[i] = np.asarray(
-                        apply_chain(seq.sampling.logits_processors, seq.output_ids, jnp.asarray(rows[i]))
-                    )
-            logits = jnp.asarray(rows)
+            proc_rows = [i for i, seq in enumerate(batch) if seq.sampling.logits_processors]
+            sel = jnp.asarray(np.asarray(proc_rows, dtype=np.int32))
+            sub = np.array(logits[sel])  # [n_proc, V] writable host copy
+            for j, i in enumerate(proc_rows):
+                sub[j] = np.asarray(
+                    apply_chain(batch[i].sampling.logits_processors, batch[i].output_ids, jnp.asarray(sub[j]))
+                )
+            logits = logits.at[sel].set(jnp.asarray(sub))
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
         row_keys = None
@@ -1382,11 +1451,30 @@ class Scheduler:
                 key, jnp.asarray(seeds), jnp.asarray(poss_out), jnp.asarray(has_seed)
             )
         temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
-        sampled = np.asarray(
-            self._sample_jit(
-                logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
+        if any(seq.guided is not None for seq in batch):
+            # Guided rows: gather each row's FSM-state mask from the shared
+            # device pool inside the fused mask+sample dispatch. Unguided
+            # rows point at the reserved allow-all row 0, so the mixed batch
+            # shares one executable.
+            pool = self.guided.pool.device()
+            k_rows = np.zeros((2, bucket), dtype=np.int32)
+            k_rows[0] = top_ks
+            for i, seq in enumerate(batch):
+                if seq.guided is not None:
+                    k_rows[1, i] = seq.guided.row_id
+            self.flight.record_exec("guided_sample", (bucket, int(pool.shape[0])))
+            sampled = np.asarray(
+                self._guided_sample_jit(
+                    logits, pool, jnp.asarray(k_rows),
+                    jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
+                )
             )
-        )
+        else:
+            sampled = np.asarray(
+                self._sample_jit(
+                    logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
+                )
+            )
         logprobs_np = None
         if any(seq.sampling.logprobs for seq in batch):
             from dynamo_tpu.engine.sampling import compute_logprobs
@@ -1857,13 +1945,26 @@ class Scheduler:
             from dynamo_tpu.logits_processing import apply_chain
 
             logits = apply_chain(s.logits_processors, seq.output_ids, logits)
-        tok = self._sample_jit(
-            logits[None, :],
-            jnp.asarray([s.temperature], dtype=jnp.float32),
-            jnp.asarray([s.top_k], dtype=jnp.int32),
-            jnp.asarray([s.top_p], dtype=jnp.float32),
-            self._row_key(seq),
-        )
+        if seq.guided is not None:
+            # First token after prefill: same fused mask+sample executable
+            # as the batched path at bucket 1.
+            pool = self.guided.pool.device()
+            self.flight.record_exec("guided_sample", (1, int(pool.shape[0])))
+            tok = self._guided_sample_jit(
+                logits[None, :], pool,
+                jnp.asarray([[s.top_k], [seq.guided.row_id]], dtype=jnp.int32),
+                jnp.asarray([s.temperature], dtype=jnp.float32),
+                jnp.asarray([s.top_p], dtype=jnp.float32),
+                self._row_key(seq),
+            )
+        else:
+            tok = self._sample_jit(
+                logits[None, :],
+                jnp.asarray([s.temperature], dtype=jnp.float32),
+                jnp.asarray([s.top_k], dtype=jnp.int32),
+                jnp.asarray([s.top_p], dtype=jnp.float32),
+                self._row_key(seq),
+            )
         token = int(np.asarray(tok)[0])
         if s.logprobs:
             from dynamo_tpu.engine.sampling import compute_logprobs
@@ -1880,6 +1981,10 @@ class Scheduler:
             logprob = getattr(seq, "_pending_logprob", None)
             seq._pending_logprob = None
         seq.output_ids.append(token)
+        if seq.guided is not None:
+            # Host-side FSM advance: one next-state table lookup on the
+            # token the step already read back — no extra device sync.
+            seq.guided.advance(token)
         # First token carries the request's queue time (arrival → admission).
         queue_s = None
         if len(seq.output_ids) == 1:
@@ -1901,6 +2006,10 @@ class Scheduler:
             outputs.append((seq, StepOutput(token_id=token, logprob=logprob, queue_s=queue_s)))
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:
+        if seq.guided is not None and seq.guided.exhausted:
+            # The FSM accepts and only EOS remains (or the cursor is done):
+            # force-finish instead of burning a step to sample the EOS.
+            return "stop"
         n_out = len(seq.output_ids)
         if n_out >= seq.stop.min_tokens:
             if not seq.stop.ignore_eos and token in seq.eos_token_ids:

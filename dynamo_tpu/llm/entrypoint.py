@@ -70,6 +70,13 @@ def build_local_pipeline(
     if enc is not None:
         ops.append(enc)
     ops.append(Backend(tokenizer))
+    # Guided decoding needs the serving tokenizer engine-side (token-FSM
+    # lifting); attach it unless the engine already has one.
+    if (
+        hasattr(engine, "attach_guided_tokenizer")
+        and getattr(getattr(engine, "scheduler", None), "guided", None) is None
+    ):
+        engine.attach_guided_tokenizer(tokenizer)
     return link(ops, engine)
 
 

@@ -1,0 +1,22 @@
+"""Guided decoding: grammar-constrained structured outputs.
+
+The subsystem compiles a constraint spec (JSON Schema subset, raw regex, or
+a literal choice list) into a character-level DFA (:mod:`grammar`), lifts it
+to a token-level FSM against the served tokenizer's vocabulary
+(:mod:`fsm` — per-state allowed-token bitmasks + a dense next-state table),
+and applies it jit-side through a device-resident mask pool fused into the
+batched sampling step (:mod:`processor` + engine/sampling.py) — guided rows
+ride the normal batched/mixed decode path with zero per-step host sync.
+"""
+
+from dynamo_tpu.llm.guided.grammar import (  # noqa: F401
+    CharDFA,
+    GrammarError,
+    build_guided_spec,
+    compile_regex,
+    json_object_regex,
+    schema_to_regex,
+    spec_to_dfa,
+)
+from dynamo_tpu.llm.guided.fsm import TokenFSM, compile_token_fsm  # noqa: F401
+from dynamo_tpu.llm.guided.processor import GuidedDecoder, GuidedState  # noqa: F401

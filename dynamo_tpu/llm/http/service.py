@@ -261,6 +261,19 @@ class HttpService:
                 chat_body["max_tokens" if key == "max_output_tokens" else key] = body[key]
         if body.get("tools"):
             chat_body["tools"] = oai.responses_tools_to_chat(body["tools"])
+        if body.get("tool_choice") is not None:
+            chat_body["tool_choice"] = oai.responses_tool_choice_to_chat(body["tool_choice"])
+        rf = oai.responses_text_format_to_response_format(body)
+        if rf is not None:
+            chat_body["response_format"] = rf
+        try:
+            # Mirror the chat-side structural validation (response_format /
+            # tools / tool_choice) so Responses clients get the same
+            # structured 400s, not worker-side failures.
+            oai.validate_chat_request(chat_body)
+        except oai.RequestError as e:
+            self._m_requests(model, "400").inc()
+            return web.json_response(oai.error_body(str(e)), status=400)
 
         if body.get("stream"):
             return await self._responses_stream(request, engine, chat_body, rid, model)

@@ -82,11 +82,21 @@ class MockEngineArgs:
 
 
 class _Seq:
-    def __init__(self, request_id: str, tokens: List[int], max_tokens: int, context: Context):
+    def __init__(
+        self,
+        request_id: str,
+        tokens: List[int],
+        max_tokens: int,
+        context: Context,
+        forced: Optional[List[int]] = None,
+    ):
         self.request_id = request_id
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.context = context
+        # Guided decoding: the exact token stream to emit (a grammar-valid
+        # rendering of the request's constraint) instead of prompt cycling.
+        self.forced = forced
         self.out: asyncio.Queue = asyncio.Queue()
         self.block_ids: List[int] = []
         self.hashes = []
@@ -116,9 +126,20 @@ class _Seq:
 class MockTpuEngine:
     """AsyncEngine-shaped engine emulator with a batched scheduler core."""
 
-    def __init__(self, args: Optional[MockEngineArgs] = None, *, kv_event_sink: Optional[Callable[[KvEvent], None]] = None):
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        *,
+        kv_event_sink: Optional[Callable[[KvEvent], None]] = None,
+        tokenizer=None,
+    ):
         self.args = args or MockEngineArgs()
         self._sink = kv_event_sink
+        # Guided requests render their grammar's accepted string through
+        # this tokenizer (default: the byte tokenizer the mocker stacks
+        # serve with), so the full wire path yields schema-valid output.
+        self.tokenizer = tokenizer
+        self.guided_total = 0
         self.allocator = BlockAllocator(self.args.num_blocks, on_event=self._on_event)
         self.waiting: List[_Seq] = []
         self.running: List[_Seq] = []
@@ -143,7 +164,8 @@ class MockTpuEngine:
         stop = request.get("stop_conditions") or {}
         max_tokens = int(stop.get("max_tokens") or 16)
         self.request_total += 1
-        seq = _Seq(f"mock-{self.request_total}", tokens, max_tokens, context)
+        forced = self._guided_tokens(request.get("guided_decoding"))
+        seq = _Seq(f"mock-{self.request_total}", tokens, max_tokens, context, forced=forced)
         self.waiting.append(seq)
         self._ensure_loop()
         self._wake.set()
@@ -157,6 +179,27 @@ class MockTpuEngine:
                     return
         finally:
             seq.done = True
+
+    def _guided_tokens(self, spec) -> Optional[List[int]]:
+        """Honor a guided-decoding spec: compile its grammar and emit the
+        (deterministic) shortest accepted string as the output token stream,
+        so router/frontend stacks exercise the full structured-output wire
+        path — response_format in, schema-valid JSON out — with no model."""
+        if not spec:
+            return None
+        from dynamo_tpu.llm.guided.grammar import GrammarError, spec_to_dfa
+
+        try:
+            text = spec_to_dfa(spec).shortest_accepting()
+        except GrammarError as e:
+            logger.warning("mocker ignoring uncompilable guided spec: %s", e)
+            return None
+        self.guided_total += 1
+        if self.tokenizer is not None:
+            return list(self.tokenizer.encode(text))
+        from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+        return list(ByteTokenizer().encode(text))
 
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
@@ -207,9 +250,23 @@ class MockTpuEngine:
                     continue  # reaped next iteration
                 if not self._grow_blocks(s):
                     continue  # preempted (itself) — no token this step
+                if s.forced is not None and not s.forced:
+                    # Grammar accepts the empty string: finish immediately.
+                    s.out.put_nowait({"token_ids": [], "finish_reason": "stop", "index": 0})
+                    self._finish(s)
+                    continue
                 s.generated += 1
-                token = s.tokens[s.generated % len(s.tokens)] if s.tokens else s.generated
-                finish = "length" if s.generated >= s.max_tokens else None
+                if s.forced is not None:
+                    # Guided: emit the grammar-valid stream; "stop" on the
+                    # final token (the FSM accepted), "length" if max_tokens
+                    # cuts the rendering short.
+                    token = s.forced[s.generated - 1]
+                    finish = "stop" if s.generated >= len(s.forced) else None
+                    if finish is None and s.generated >= s.max_tokens:
+                        finish = "length"
+                else:
+                    token = s.tokens[s.generated % len(s.tokens)] if s.tokens else s.generated
+                    finish = "length" if s.generated >= s.max_tokens else None
                 s.out.put_nowait({"token_ids": [token], "finish_reason": finish, "index": 0})
                 if finish:
                     self._finish(s)

@@ -88,10 +88,16 @@ class OpenAIPreprocessor(Operator):
         wire["_formatted_prompt"] = prompt
         # Output-parser directives for the Backend stage: the tool-call jail
         # arms only when the request declares tools; reasoning splitting is a
-        # model property (ref: preprocessor.rs tool-call jail).
-        if (request.get("tools") and self.tool_call_parser is not None) or self.reasoning_parser:
+        # model property (ref: preprocessor.rs tool-call jail). A FORCED
+        # tool call (guided tool_choice) must parse even without a named
+        # parser — the grammar emits bare {"name":..,"arguments":{..}} JSON,
+        # which the "default" config round-trips into an OpenAI tool_call.
+        tool_parser = self.tool_call_parser if request.get("tools") else None
+        if tool_parser is None and (req.guided_decoding or {}).get("forced_tools"):
+            tool_parser = "default"
+        if tool_parser or self.reasoning_parser:
             wire["parser_options"] = {
-                "tool_call_parser": self.tool_call_parser if request.get("tools") else None,
+                "tool_call_parser": tool_parser,
                 "reasoning_parser": self.reasoning_parser,
             }
         return wire
@@ -140,6 +146,13 @@ class OpenAIPreprocessor(Operator):
         stop_conditions = stop_conditions_from_request(body)
         if stop_conditions.get("max_tokens") is None:
             stop_conditions["max_tokens"] = self.default_max_tokens
+        # Guided decoding: response_format / forced tool_choice / nvext
+        # guided_* → normalized grammar spec. Unsupported or malformed
+        # constraints raise RequestError here (a structured 400) — the
+        # engine only ever sees pre-validated, compilable patterns.
+        from dynamo_tpu.llm.guided.grammar import build_guided_spec
+
+        guided = build_guided_spec(body)
         return PreprocessedRequest(
             token_ids=token_ids,
             sampling_options=sampling_from_request(body),
@@ -148,4 +161,5 @@ class OpenAIPreprocessor(Operator):
             model=body.get("model", ""),
             router_overrides=nvext.get("router") or {},
             image_urls=image_urls,
+            guided_decoding=guided,
         ), prompt

@@ -64,6 +64,11 @@ class PreprocessedRequest:
     # Multimodal: image data URLs extracted from chat content parts; the
     # EncodeOperator (multimodal.py) turns them into embedding features.
     image_urls: List[str] = field(default_factory=list)
+    # Guided decoding: normalized constraint spec ({"kind": "regex",
+    # "pattern": ...}) the worker's engine compiles to a token FSM
+    # (llm/guided). Built by the preprocessor from response_format /
+    # tool_choice / nvext guided_* — the wire stays text-free.
+    guided_decoding: Optional[Dict[str, Any]] = None
 
     def to_wire(self) -> dict:
         d = {
@@ -77,6 +82,8 @@ class PreprocessedRequest:
         }
         if self.image_urls:
             d["_mm_image_urls"] = self.image_urls
+        if self.guided_decoding:
+            d["guided_decoding"] = self.guided_decoding
         return d
 
     @classmethod
@@ -89,6 +96,7 @@ class PreprocessedRequest:
             model=d.get("model", ""),
             router_overrides=d.get("router_overrides") or {},
             disagg_params=d.get("disagg_params") or {},
+            guided_decoding=d.get("guided_decoding"),
         )
 
 

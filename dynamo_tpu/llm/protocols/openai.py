@@ -59,13 +59,99 @@ def validate_chat_request(body: dict) -> dict:
         stop is None or isinstance(stop, str) or (isinstance(stop, list) and all(isinstance(s, str) for s in stop)),
         "stop must be a string or array of strings",
     )
+    _validate_response_format(body)
+    _validate_tools(body)
+    _validate_tool_choice(body)
     return body
+
+
+RESPONSE_FORMAT_TYPES = ("text", "json_object", "json_schema")
+
+
+def _validate_response_format(body: dict) -> None:
+    """Structural response_format checks (ref: validate.rs response_format).
+    Schema *compilability* is checked by the preprocessor's grammar build —
+    both layers raise RequestError, so malformed constraints are always a
+    structured 400, never a 500."""
+    rf = body.get("response_format")
+    if rf is None:
+        return
+    _require(
+        isinstance(rf, dict) and isinstance(rf.get("type"), str),
+        "response_format must be an object with a string 'type'",
+    )
+    _require(
+        rf["type"] in RESPONSE_FORMAT_TYPES,
+        f"response_format.type must be one of {list(RESPONSE_FORMAT_TYPES)}",
+    )
+    if rf["type"] == "json_schema":
+        js = rf.get("json_schema")
+        _require(isinstance(js, dict), "response_format.json_schema must be an object")
+        _require(
+            isinstance(js.get("schema"), dict),
+            "response_format.json_schema.schema is required and must be an object",
+        )
+        name = js.get("name")
+        _require(name is None or isinstance(name, str), "json_schema.name must be a string")
+
+
+def _validate_tools(body: dict) -> None:
+    tools = body.get("tools")
+    if tools is None:
+        return
+    _require(isinstance(tools, list), "tools must be an array")
+    for t in tools:
+        _require(
+            isinstance(t, dict) and t.get("type") == "function" and isinstance(t.get("function"), dict),
+            "each tool must be {type: 'function', function: {...}}",
+        )
+        fn = t["function"]
+        _require(isinstance(fn.get("name"), str) and bool(fn["name"]), "tool function.name is required")
+        params = fn.get("parameters")
+        _require(params is None or isinstance(params, dict), "tool function.parameters must be an object")
+
+
+def _tool_names(body: dict) -> List[str]:
+    return [
+        (t.get("function") or {}).get("name")
+        for t in (body.get("tools") or [])
+        if isinstance(t, dict)
+    ]
+
+
+def _validate_tool_choice(body: dict) -> None:
+    tc = body.get("tool_choice")
+    if tc is None:
+        return
+    if isinstance(tc, str):
+        _require(
+            tc in ("none", "auto", "required"),
+            "tool_choice must be 'none', 'auto', 'required', or {type:'function',function:{name}}",
+        )
+        _require(
+            tc != "required" or bool(body.get("tools")),
+            "tool_choice 'required' needs a non-empty tools array",
+        )
+        return
+    _require(
+        isinstance(tc, dict)
+        and tc.get("type") == "function"
+        and isinstance(tc.get("function"), dict)
+        and isinstance(tc["function"].get("name"), str),
+        "named tool_choice must be {type: 'function', function: {name: ...}}",
+    )
+    name = tc["function"]["name"]
+    _require(
+        name in _tool_names(body),
+        f"tool_choice names unknown tool {name!r}",
+    )
 
 
 MAX_N = 8  # per-request choice fan-out cap (each choice is a full generation)
 
 
 def _validate_common_sampling(body: dict) -> None:
+    _validate_guided_ext(body)
     n = body.get("n")
     _require(
         n is None or (isinstance(n, int) and 1 <= n <= MAX_N),
@@ -85,6 +171,26 @@ def _validate_common_sampling(body: dict) -> None:
                 isinstance(v, (int, float)) and not isinstance(v, bool) and -100.0 <= v <= 100.0,
                 "logit_bias values must be numbers in [-100, 100]",
             )
+
+
+def _validate_guided_ext(body: dict) -> None:
+    """nvext guided-decoding extensions (guided_regex / guided_choice /
+    guided_json) — structural checks; at most one constraint per request."""
+    nv = body.get("nvext") or {}
+    gr = nv.get("guided_regex")
+    _require(gr is None or (isinstance(gr, str) and bool(gr)), "nvext.guided_regex must be a non-empty string")
+    gc = nv.get("guided_choice")
+    _require(
+        gc is None
+        or (isinstance(gc, list) and len(gc) > 0 and all(isinstance(c, str) and c for c in gc)),
+        "nvext.guided_choice must be a non-empty array of strings",
+    )
+    gj = nv.get("guided_json")
+    _require(gj is None or isinstance(gj, dict), "nvext.guided_json must be a schema object")
+    _require(
+        sum(x is not None for x in (gr, gc, gj)) <= 1,
+        "at most one nvext guided_* constraint per request",
+    )
 
 
 def validate_completion_request(body: dict) -> dict:
@@ -372,6 +478,33 @@ def responses_input_to_messages(body: dict) -> list:
             )
         messages.append({"role": role, "content": content})
     return messages
+
+
+def responses_text_format_to_response_format(body: dict) -> Optional[dict]:
+    """Responses-API structured outputs → chat ``response_format``. The
+    Responses API nests the format flat under ``text.format``
+    (``{type: 'json_schema', name, schema}``); chat nests it under
+    ``response_format.json_schema``. A chat-shaped ``response_format`` on
+    the body passes through unchanged."""
+    txt = body.get("text")
+    fmt = txt.get("format") if isinstance(txt, dict) else None
+    if isinstance(fmt, dict) and fmt.get("type"):
+        if fmt["type"] == "json_schema":
+            return {
+                "type": "json_schema",
+                "json_schema": {k: fmt[k] for k in ("name", "schema", "strict") if k in fmt},
+            }
+        return {"type": fmt["type"]}
+    rf = body.get("response_format")
+    return rf if isinstance(rf, dict) else None
+
+
+def responses_tool_choice_to_chat(tc):
+    """Responses-API flat named tool_choice (``{type:'function', name}``) →
+    chat shape; strings and chat-shaped dicts pass through."""
+    if isinstance(tc, dict) and tc.get("type") == "function" and "function" not in tc and tc.get("name"):
+        return {"type": "function", "function": {"name": tc["name"]}}
+    return tc
 
 
 def responses_tools_to_chat(tools: Optional[list]) -> list:

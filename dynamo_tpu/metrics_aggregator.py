@@ -36,6 +36,8 @@ COUNTER_KEYS = (
     "moe_dropped_total", "moe_assignments_total",
     "mixed_steps_total", "mixed_prefill_tokens_total", "mixed_decode_tokens_total",
     "compiles_total", "compiles_after_warmup_total",
+    "guided_requests_total", "guided_grammar_compiles_total",
+    "guided_grammar_compile_seconds_total",
     "step_prefill_steps_total", "step_prefill_time_seconds_total", "step_prefill_tokens_total",
     "step_decode_steps_total", "step_decode_time_seconds_total", "step_decode_tokens_total",
     "step_mixed_steps_total", "step_mixed_time_seconds_total", "step_mixed_tokens_total",

@@ -126,11 +126,16 @@ async def amain(args) -> None:
             from dynamo_tpu.engine.sharding import ParallelConfig
 
             parallel = ParallelConfig(tp=args.tp, dp=args.dp, ep=args.ep, pp=args.pp)
+        from dynamo_tpu.llm.tokenizer import load_tokenizer
+
         engine = TpuEngine.build(
             EngineArgs(
                 model=args.model,
                 dtype=args.dtype,
                 checkpoint_path=args.checkpoint,
+                # Guided decoding compiles token FSMs against the SAME
+                # tokenizer the frontend detokenizes with (the model card's).
+                tokenizer=load_tokenizer(args.tokenizer),
                 kvbm_host_blocks=args.kvbm_host_blocks,
                 kvbm_disk_dir=args.kvbm_disk_dir,
                 kvbm_disk_blocks=args.kvbm_disk_blocks,

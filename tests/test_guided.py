@@ -1,0 +1,527 @@
+"""Guided decoding (structured outputs): token-FSM mask oracle, scheduler
+greedy guided decode + compile-count bound, HTTP e2e (response_format /
+forced tool_choice), protocol 400s, and mocker wire-path honor.
+
+The oracle test is exact: for bounded-language specs it enumerates every
+viable prefix with Python ``re`` as ground truth, then checks the token
+mask bit-for-bit — every allowed token keeps the string matchable, every
+disallowed token breaks it.
+"""
+
+import itertools
+import json
+import random
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+from dynamo_tpu.llm.guided.fsm import compile_token_fsm
+from dynamo_tpu.llm.guided.grammar import (
+    GrammarError,
+    build_guided_spec,
+    compile_regex,
+    json_object_regex,
+    schema_to_regex,
+    spec_to_dfa,
+)
+from dynamo_tpu.llm.guided.processor import GuidedDecoder
+from dynamo_tpu.llm.protocols import openai as oai
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+CFG = get_config("tiny")
+EOS = 0
+SCHEMA = {
+    "type": "object",
+    "properties": {"city": {"enum": ["SF", "NY"]}, "ok": {"type": "boolean"}},
+}
+
+_TOKEN_STRS = [ByteTokenizer().decode([i]) for i in range(256)]
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _sched(**kw):
+    base = dict(
+        num_blocks=128,
+        prefill_buckets=[16, 32, 64],
+        decode_buckets=[1, 2, 4],
+        num_scheduler_steps=1,
+        enable_prefix_caching=False,
+        guided_pool_rows=256,
+    )
+    base.update(kw)
+    sched = Scheduler(CFG, _params(), SchedulerConfig(**base), dtype=jnp.float32, eos_token_ids=[EOS])
+    sched.attach_guided(ByteTokenizer())
+    return sched
+
+
+def _drain(sched, max_steps=600):
+    outs, fin = {}, {}
+    steps = 0
+    while sched.has_work() and steps < max_steps:
+        steps += 1
+        for seq, o in sched.step():
+            if o.token_id >= 0:
+                outs.setdefault(seq.request_id, []).append(o.token_id)
+            if o.finished:
+                fin[seq.request_id] = o.finish_reason
+    assert not sched.has_work(), "scheduler did not drain"
+    return outs, fin
+
+
+# --- token-FSM mask oracle ---------------------------------------------------
+
+
+def _viable_prefixes(pattern, charset, max_len):
+    """Ground truth via Python re: all prefixes of the (bounded) language
+    enumerated over ``charset`` up to ``max_len``."""
+    viable = set()
+    for n in range(max_len + 1):
+        for combo in itertools.product(charset, repeat=n):
+            s = "".join(combo)
+            if re.fullmatch(pattern, s):
+                for i in range(len(s) + 1):
+                    viable.add(s[:i])
+    return viable
+
+
+ORACLE_SPECS = [
+    # Finite languages only: the re-enumeration ground truth must cover the
+    # WHOLE language within max_len for the viability check to be exact.
+    ("(ab|cd){1,3}", "abcd", 6),
+    ("a?b{1,2}c{2}", "abc", 5),
+    ("[xy]{2,4}", "xy", 4),
+    ("(foo|bar|foobar)", "fobar", 6),
+    ('"(SF|NY)"', '"SFNY', 4),
+    ("x(12|345)?y", "12345xy", 6),
+]
+
+
+def _random_choice_specs(rng, n=6):
+    words = ["ab", "ba", "aab", "bba", "abb", "a", "b"]
+    out = []
+    for _ in range(n):
+        picks = rng.sample(words, rng.randint(2, 4))
+        out.append(("(?:" + "|".join(picks) + ")", "ab", max(len(w) for w in picks)))
+    return out
+
+
+def test_token_fsm_mask_oracle():
+    rng = random.Random(7)
+    for pattern, charset, max_len in ORACLE_SPECS + _random_choice_specs(rng):
+        dfa = compile_regex(pattern)
+        fsm = compile_token_fsm(dfa, _TOKEN_STRS, eos_ids=[EOS])
+        viable = _viable_prefixes(pattern, charset, max_len)
+        assert "" in viable, pattern
+        for prefix in sorted(viable):
+            state = 0
+            for ch in prefix:
+                state = int(fsm.next_state[state, ord(ch)])
+            assert state >= 0, (pattern, prefix)
+            for ch in charset:
+                allowed = fsm.allows(state, ord(ch))
+                assert allowed == ((prefix + ch) in viable), (pattern, prefix, ch)
+            # EOS is allowed exactly when the prefix is a complete match.
+            assert fsm.allows(state, EOS) == bool(re.fullmatch(pattern, prefix)), (
+                pattern, prefix,
+            )
+
+
+def test_schema_fsm_random_walks_emit_valid_json():
+    """Random mask-following walks over schema grammars always land on
+    strings that re-fullmatch the schema regex AND json-parse."""
+    rng = random.Random(3)
+    schemas = [
+        SCHEMA,
+        {"type": "object", "properties": {
+            "tags": {"type": "array", "items": {"enum": ["a", "b"]}, "maxItems": 3},
+            "level": {"enum": [1, 2, 3]},
+        }},
+        {"type": "object", "properties": {
+            "name": {"type": "string", "maxLength": 4},
+            "score": {"anyOf": [{"type": "integer"}, {"type": "null"}]},
+        }},
+    ]
+    for schema in schemas:
+        pattern = schema_to_regex(schema)
+        fsm = compile_token_fsm(compile_regex(pattern), _TOKEN_STRS, eos_ids=[EOS])
+        for _ in range(10):
+            state, chars = 0, []
+            for _step in range(200):
+                allowed = [t for t in range(1, 256) if fsm.allows(state, t)]
+                if fsm.allows(state, EOS) and (not allowed or rng.random() < 0.5):
+                    break
+                tok = rng.choice(allowed)
+                chars.append(chr(tok))
+                state = int(fsm.next_state[state, tok])
+            s = "".join(chars)
+            assert re.fullmatch(pattern, s), (schema, s)
+            json.loads(s)
+
+
+def test_json_object_regex_and_dfa_agree_with_re():
+    pattern = json_object_regex()
+    dfa = compile_regex(pattern)
+    good = ['{}', '{"a":1}', '{"a":{"b":[1,2]},"c":"x"}', '{"k":"v","l":[true,null]}']
+    bad = ['{"k":}', '[1]', '{', '{"a" :1}', 'null']
+    for s in good:
+        assert dfa.match(s) and re.fullmatch(pattern, s), s
+    for s in bad:
+        assert not dfa.match(s) and not re.fullmatch(pattern, s), s
+
+
+def test_grammar_rejections():
+    for pattern in ["(?=a)b", "a**b[", "[z-a]", "(a", "a\\1", "^a$"]:
+        with pytest.raises(GrammarError):
+            compile_regex(pattern)
+    for schema in [{"$ref": "#/defs/x"}, {"allOf": [{}]}, {"type": "object", "properties": {"a": {"$ref": "#"}}}]:
+        with pytest.raises(GrammarError):
+            schema_to_regex(schema)
+    with pytest.raises(GrammarError):
+        spec_to_dfa({"kind": "nope"})
+
+
+# --- scheduler-level ---------------------------------------------------------
+
+
+def test_scheduler_greedy_guided_yields_schema_valid_json():
+    sched = _sched()
+    pattern = schema_to_regex(SCHEMA)
+    sched.add_request(
+        "g", list(range(1, 17)), SamplingParams(temperature=0.0),
+        StopConditions(max_tokens=64), guided={"kind": "regex", "pattern": pattern},
+    )
+    outs, fin = _drain(sched)
+    text = ByteTokenizer().decode(outs["g"])
+    assert fin["g"] == "stop"
+    assert re.fullmatch(pattern, text)
+    obj = json.loads(text)
+    assert obj["city"] in ("SF", "NY") and isinstance(obj["ok"], bool)
+    assert sched.guided.stats()["guided_requests_total"] == 1
+
+
+def test_guided_row_does_not_perturb_unguided_batchmates():
+    """Unguided rows in a batch that carries a guided row sample through the
+    allow-all pool row — their greedy outputs must equal a run without the
+    guided row."""
+    ref = _sched()
+    for i in range(2):
+        ref.add_request(f"u{i}", list(range(1 + i, 17 + i)), SamplingParams(temperature=0.0),
+                        StopConditions(max_tokens=12))
+    want, _ = _drain(ref)
+
+    sched = _sched()
+    for i in range(2):
+        sched.add_request(f"u{i}", list(range(1 + i, 17 + i)), SamplingParams(temperature=0.0),
+                          StopConditions(max_tokens=12))
+    sched.add_request(
+        "g", list(range(5, 21)), SamplingParams(temperature=0.0),
+        StopConditions(max_tokens=48),
+        guided={"kind": "regex", "pattern": schema_to_regex(SCHEMA)},
+    )
+    got, fin = _drain(sched)
+    assert got["u0"] == want["u0"] and got["u1"] == want["u1"]
+    assert fin["g"] == "stop"
+    json.loads(ByteTokenizer().decode(got["g"]))
+
+
+def test_guided_choice_and_sampled_temperature():
+    """Non-greedy guided sampling still honors the mask (whatever the
+    temperature draws, it must be one of the choices)."""
+    sched = _sched()
+    sched.add_request(
+        "c", list(range(1, 17)), SamplingParams(temperature=1.0, seed=11),
+        StopConditions(max_tokens=16),
+        guided={"kind": "choice", "choices": ["red", "green", "blue"]},
+    )
+    outs, fin = _drain(sched)
+    assert fin["c"] == "stop"
+    assert ByteTokenizer().decode(outs["c"]) in ("red", "green", "blue")
+
+
+def test_guided_no_compiles_after_warmup():
+    """Guided rows joining a warmed batch add no post-warmup XLA compiles
+    (flight-recorder-verified): the masked-sampling executables are part of
+    warmup()'s serving set."""
+    sched = _sched(enable_mixed_batching=False)
+    sched.warmup(128)
+    sched.flight.mark_warmup_done(warmed=True)
+    pattern = schema_to_regex(SCHEMA)
+    # Staggered adds: admission paths (single prefill) all warmed; guided
+    # rows then ride the batched decode + bucket-1 first-token sampler.
+    sched.add_request("u0", list(range(1, 17)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=40))
+    for _ in range(3):
+        sched.step()
+    sched.add_request("g", list(range(3, 19)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=64), guided={"kind": "regex", "pattern": pattern})
+    for _ in range(3):
+        sched.step()
+    sched.add_request("u1", list(range(7, 23)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=30))
+    _, fin = _drain(sched)
+    assert fin["g"] == "stop"
+    assert sched.flight.compiles_after_warmup_total == 0, sched.flight.post_warmup_keys
+
+
+def test_guided_rides_mixed_steps():
+    """A guided head-of-queue prompt rides mixed prefill+decode dispatches
+    and still emits grammar-valid output."""
+    sched = _sched(enable_mixed_batching=True, mixed_prefill_budget=32)
+    sched.add_request("d", list(range(1, 17)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=60))
+    for _ in range(3):
+        sched.step()
+    pattern = schema_to_regex(SCHEMA)
+    sched.add_request("g", list(range(2, 50)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=64), guided={"kind": "regex", "pattern": pattern})
+    outs, fin = _drain(sched)
+    assert sched.mixed_steps_total >= 1
+    assert fin["g"] == "stop"
+    assert re.fullmatch(pattern, ByteTokenizer().decode(outs["g"]))
+
+
+def test_guided_with_spec_decode_falls_back_gracefully():
+    """A guided row in a draft-attached engine keeps the batch on the
+    non-speculative path (no spec rounds) and still emits valid output."""
+    sched = _sched()
+    draft_params = llama.init_params(CFG, jax.random.PRNGKey(9), dtype=jnp.float32)
+    sched.attach_draft(CFG, draft_params, gamma=2)
+    pattern = schema_to_regex(SCHEMA)
+    sched.add_request("g", list(range(1, 17)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=64), guided={"kind": "regex", "pattern": pattern})
+    outs, fin = _drain(sched)
+    assert fin["g"] == "stop"
+    assert re.fullmatch(pattern, ByteTokenizer().decode(outs["g"]))
+    assert sched.spec_stats.num_rounds == 0
+
+
+def test_guided_requires_attached_tokenizer():
+    sched = Scheduler(CFG, _params(), SchedulerConfig(num_blocks=64), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="tokenizer"):
+        sched.add_request("g", [1, 2, 3], SamplingParams(), StopConditions(),
+                          guided={"kind": "regex", "pattern": "ab"})
+
+
+# --- protocol validation -----------------------------------------------------
+
+
+def _chat_body(**extra):
+    return {"model": "m", "messages": [{"role": "user", "content": "x"}], **extra}
+
+
+def test_protocol_response_format_and_tool_choice_400s():
+    bad = [
+        _chat_body(response_format="json"),
+        _chat_body(response_format={"type": "nope"}),
+        _chat_body(response_format={"type": "json_schema"}),
+        _chat_body(response_format={"type": "json_schema", "json_schema": {}}),
+        _chat_body(tools=[{"type": "function"}]),
+        _chat_body(tools=[{"type": "function", "function": {"name": "a"}}],
+                   tool_choice={"type": "function", "function": {"name": "b"}}),
+        _chat_body(tool_choice="required"),  # no tools
+        _chat_body(tool_choice="maybe"),
+        _chat_body(nvext={"guided_regex": ""}),
+        _chat_body(nvext={"guided_choice": []}),
+        _chat_body(nvext={"guided_regex": "a", "guided_choice": ["b"]}),
+    ]
+    for body in bad:
+        with pytest.raises(oai.RequestError):
+            oai.validate_chat_request(body)
+    # Good shapes pass.
+    oai.validate_chat_request(_chat_body(
+        response_format={"type": "json_schema", "json_schema": {"name": "x", "schema": SCHEMA}},
+        tools=[{"type": "function", "function": {"name": "a", "parameters": SCHEMA}}],
+        tool_choice={"type": "function", "function": {"name": "a"}},
+    ))
+    oai.validate_chat_request(_chat_body(tool_choice="auto"))
+
+
+def test_build_guided_spec_precedence_and_400s():
+    # Forced tool choice wins over response_format.
+    spec = build_guided_spec(_chat_body(
+        tools=[{"type": "function", "function": {"name": "f", "parameters": SCHEMA}}],
+        tool_choice="required",
+        response_format={"type": "json_object"},
+    ))
+    assert spec["source"] == "tool_choice" and spec["forced_tools"] == ["f"]
+    # Unsupported schema constructs are structured 400s.
+    with pytest.raises(oai.RequestError):
+        build_guided_spec(_chat_body(
+            response_format={"type": "json_schema",
+                             "json_schema": {"schema": {"$ref": "#/x"}}},
+        ))
+    with pytest.raises(oai.RequestError):
+        build_guided_spec(_chat_body(nvext={"guided_regex": "(?=a)b"}))
+    # tool_choice auto / none / plain text produce no constraint.
+    assert build_guided_spec(_chat_body(tool_choice="auto")) is None
+    assert build_guided_spec(_chat_body(response_format={"type": "text"})) is None
+
+
+def test_responses_text_format_translation():
+    body = {"model": "m", "input": "hi",
+            "text": {"format": {"type": "json_schema", "name": "x", "schema": SCHEMA}}}
+    rf = oai.responses_text_format_to_response_format(body)
+    assert rf == {"type": "json_schema", "json_schema": {"name": "x", "schema": SCHEMA}}
+    assert oai.responses_tool_choice_to_chat({"type": "function", "name": "f"}) == {
+        "type": "function", "function": {"name": "f"}}
+    assert oai.responses_tool_choice_to_chat("auto") == "auto"
+
+
+# --- HTTP e2e ----------------------------------------------------------------
+
+
+async def _service():
+    import aiohttp  # noqa: F401 — fail fast if missing
+
+    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+    from dynamo_tpu.llm.http.service import HttpService
+
+    engine = TpuEngine.build(EngineArgs(
+        model="tiny", dtype="float32", eos_token_ids=[EOS],
+        scheduler=SchedulerConfig(
+            num_blocks=64, prefill_buckets=[16, 32, 64, 128],
+            decode_buckets=[1, 2, 4, 8], guided_pool_rows=256,
+        ),
+    ))
+    manager = ModelManager()
+    manager.add_model("chat", "tiny-chat", build_local_pipeline(ByteTokenizer(), engine))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service, engine
+
+
+async def test_http_response_format_json_schema_roundtrip():
+    import aiohttp
+
+    service, engine = await _service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "city?"}],
+                "max_tokens": 64, "temperature": 0,
+                "response_format": {"type": "json_schema",
+                                    "json_schema": {"name": "city", "schema": SCHEMA}},
+            }
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        choice = data["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        obj = json.loads(choice["message"]["content"])
+        assert obj["city"] in ("SF", "NY") and isinstance(obj["ok"], bool)
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def test_http_forced_tool_choice_roundtrips_parser():
+    import aiohttp
+
+    service, engine = await _service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "call the tool"}],
+                "max_tokens": 96, "temperature": 0,
+                "tools": [{"type": "function",
+                           "function": {"name": "get_city", "parameters": SCHEMA}}],
+                "tool_choice": {"type": "function", "function": {"name": "get_city"}},
+            }
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        choice = data["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        call = choice["message"]["tool_calls"][0]
+        assert call["function"]["name"] == "get_city"
+        args = json.loads(call["function"]["arguments"])
+        assert args["city"] in ("SF", "NY") and isinstance(args["ok"], bool)
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def test_http_guided_400s_never_500s():
+    import aiohttp
+
+    service, engine = await _service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            for bad in [
+                {"response_format": {"type": "json_schema"}},
+                {"response_format": {"type": "bogus"}},
+                {"response_format": {"type": "json_schema",
+                                     "json_schema": {"schema": {"$ref": "#/x"}}}},
+                {"tools": [{"type": "function", "function": {"name": "a"}}],
+                 "tool_choice": {"type": "function", "function": {"name": "b"}}},
+                {"nvext": {"guided_regex": "(?=x)y"}},
+            ]:
+                body = {"model": "tiny-chat",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "max_tokens": 4, **bad}
+                async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                    assert r.status == 400, (bad, r.status, await r.text())
+                    assert "error" in await r.json()
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+# --- mocker wire path --------------------------------------------------------
+
+
+async def test_mocker_honors_guided_requests():
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+    from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+    from dynamo_tpu.runtime.engine import Annotated, Context
+
+    engine = MockTpuEngine(MockEngineArgs(speedup_ratio=50.0))
+    pipe = build_local_pipeline(ByteTokenizer(), engine)
+
+    async def run(body):
+        text, finish, calls = [], None, None
+        async for item in pipe.generate(body, Context()):
+            if isinstance(item, Annotated) and item.is_annotation():
+                continue
+            wire = item.data if isinstance(item, Annotated) else item
+            if wire.get("text"):
+                text.append(wire["text"])
+            if wire.get("tool_calls"):
+                calls = wire["tool_calls"]
+            if wire.get("finish_reason"):
+                finish = wire["finish_reason"]
+        return "".join(text), finish, calls
+
+    text, finish, _ = await run({
+        "model": "mock", "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 64,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"name": "c", "schema": SCHEMA}},
+    })
+    assert finish == "stop"
+    obj = json.loads(text)
+    assert obj["city"] in ("SF", "NY")
+    assert engine.guided_total == 1
+
+    _, finish, calls = await run({
+        "model": "mock", "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 96,
+        "tools": [{"type": "function", "function": {"name": "get_city", "parameters": SCHEMA}}],
+        "tool_choice": "required",
+    })
+    assert finish == "tool_calls"
+    assert calls[0]["function"]["name"] == "get_city"
+    json.loads(calls[0]["function"]["arguments"])
