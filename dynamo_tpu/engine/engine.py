@@ -403,6 +403,11 @@ class TpuEngine:
             "mixed_steps_total": m.mixed_steps_total,
             "mixed_prefill_tokens_total": m.mixed_prefill_tokens_total,
             "mixed_decode_tokens_total": m.mixed_decode_tokens_total,
+            # Zero-bubble decode pipeline: overlapped steps vs flushes back
+            # to the sync path (admission/finish/growth/extras). The gap
+            # histogram itself rides flight.to_stats() below.
+            "overlap_steps_total": m.overlap_steps_total,
+            "overlap_flushes_total": m.overlap_flushes_total,
         }
         # Flight recorder: per-phase step/token counters + the XLA compile
         # tracker (compiles_after_warmup_total > 0 in steady state is the

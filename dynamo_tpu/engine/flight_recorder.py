@@ -37,21 +37,50 @@ STEP_BUCKETS: Tuple[float, ...] = (
 
 PHASES = ("prefill", "decode", "mixed", "wave", "spec")
 
+# Host-gap buckets: the decode pipeline's subject is the SUB-millisecond
+# window between a dispatch returning and the next dispatch being issued —
+# far finer-grained than step durations. Overlapped steady state should sit
+# in the lowest buckets; sync-path steps pay the full
+# readback+bookkeeping+upload gap (ms to tens of ms on tunneled devices).
+GAP_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25
+)
+
 
 class _PhaseHist:
-    __slots__ = ("counts", "total", "sum_s", "tokens")
+    __slots__ = ("counts", "total", "sum_s", "tokens", "buckets")
 
-    def __init__(self) -> None:
-        self.counts = [0] * (len(STEP_BUCKETS) + 1)
+    def __init__(self, buckets: Tuple[float, ...] = STEP_BUCKETS) -> None:
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
         self.total = 0
         self.sum_s = 0.0
         self.tokens = 0
 
     def observe(self, dur_s: float, tokens: int) -> None:
-        self.counts[bisect.bisect_left(STEP_BUCKETS, dur_s)] += 1
+        self.counts[bisect.bisect_left(self.buckets, dur_s)] += 1
         self.total += 1
         self.sum_s += dur_s
         self.tokens += tokens
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile (0..1) from the bucket counts: linear
+        interpolation within the covering bucket, upper bound for +Inf."""
+        if not self.total:
+            return 0.0
+        rank = q * self.total
+        seen = 0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+            if seen + c >= rank:
+                if c == 0:
+                    return hi
+                frac = (rank - seen) / c
+                return lo + frac * (hi - lo)
+            seen += c
+            lo = hi
+        return self.buckets[-1]
 
 
 class FlightRecorder:
@@ -59,6 +88,11 @@ class FlightRecorder:
 
     def __init__(self) -> None:
         self._hists: Dict[str, _PhaseHist] = {p: _PhaseHist() for p in PHASES}
+        # Decode host gap: time from a decode dispatch RETURNING (device
+        # launched, host free) to the NEXT decode dispatch being issued —
+        # the bubble the overlap pipeline exists to close. Only consecutive
+        # decode-family dispatches are measured (phase changes reset it).
+        self._gap = _PhaseHist(GAP_BUCKETS)
         # Compile tracker state.
         self._exec_keys: Set[tuple] = set()
         self.compiles_total = 0
@@ -78,6 +112,14 @@ class FlightRecorder:
         h.observe(dur_s, tokens)
         self.last_step_phase = phase
         self.last_step_s = dur_s
+
+    def record_host_gap(self, gap_s: float) -> None:
+        """One dispatch-return → next-dispatch interval on the decode path."""
+        self._gap.observe(gap_s, 0)
+
+    def gap_percentile(self, q: float) -> float:
+        """Approximate decode-host-gap quantile in SECONDS (bench reporting)."""
+        return self._gap.percentile(q)
 
     # --- compile tracking ---------------------------------------------------
     def record_exec(self, kind: str, key: tuple) -> bool:
@@ -115,6 +157,11 @@ class FlightRecorder:
         out: dict = {
             "compiles_total": self.compiles_total,
             "compiles_after_warmup_total": self.compiles_after_warmup_total,
+            # Host-gap histogram exported as sum+count counters: PromQL
+            # rate(sum)/rate(count) is the live average gap; bench reads
+            # the full bucket histogram host-side for p50/p99.
+            "decode_host_gap_events_total": self._gap.total,
+            "decode_host_gap_seconds_total": round(self._gap.sum_s, 6),
         }
         for phase, h in self._hists.items():
             if not h.total and phase not in ("prefill", "decode", "mixed"):
@@ -125,9 +172,10 @@ class FlightRecorder:
         return out
 
     def histogram(self, phase: str) -> Tuple[Tuple[float, ...], List[int]]:
-        """(bucket upper bounds, counts incl. +Inf) for one phase."""
-        h = self._hists[phase]
-        return STEP_BUCKETS, list(h.counts)
+        """(bucket upper bounds, counts incl. +Inf) for one phase; the
+        ``"host_gap"`` pseudo-phase returns the decode host-gap histogram."""
+        h = self._gap if phase == "host_gap" else self._hists[phase]
+        return h.buckets, list(h.counts)
 
 
 class StepTimer:

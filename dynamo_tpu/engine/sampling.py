@@ -187,6 +187,42 @@ def guided_sample_batch(
     )
 
 
+def sample_batch_logprobs(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    key: jax.Array,
+    row_keys: Optional[jax.Array] = None,
+) -> tuple:
+    """``sample_batch`` with the chosen-token log-probabilities folded into
+    the SAME dispatch → (tokens [B] i32, logprobs [B] f32). When any row
+    requests logprobs the scheduler used to issue a separate
+    ``compute_logprobs`` device op (+ its own host sync) per step; fusing it
+    here keeps logprobs batches at one dispatch and one readback, same as
+    plain ones."""
+    tok = sample_batch(logits, temperature, top_k, top_p, key, row_keys)
+    return tok, compute_logprobs(logits, tok)
+
+
+def guided_sample_batch_logprobs(
+    logits: jax.Array,
+    pool: jax.Array,
+    k_rows: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    key: jax.Array,
+    row_keys: Optional[jax.Array] = None,
+) -> tuple:
+    """``guided_sample_batch`` + fused logprobs (see sample_batch_logprobs).
+    Logprobs are of the MASKED distribution — the model's renormalized
+    probability over the FSM-allowed set, which is what the row actually
+    sampled from."""
+    masked = apply_token_masks(logits, pool, k_rows[1])
+    tok = sample_batch(masked, temperature, k_rows[0], top_p, key, row_keys)
+    return tok, compute_logprobs(masked, tok)
+
+
 @jax.jit
 def apply_penalties(
     logits: jax.Array,  # [B, V] f32

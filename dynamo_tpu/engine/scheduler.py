@@ -225,6 +225,19 @@ class SchedulerConfig:
     # its blocks, re-prefill it later) instead of finishing the starved
     # sequence with "length" (ref: vLLM recompute preemption).
     enable_preemption: bool = True
+    # Zero-bubble decode: overlap the host's per-step bookkeeping with the
+    # NEXT step's device compute. The fused decode+sample executable
+    # (llama.decode_sample) returns the sampled tokens as a DEVICE array
+    # that feeds straight back as the next dispatch's input, so step N+1
+    # launches before step N's tokens ever reach the host; the readback +
+    # stop/detok bookkeeping then run one step behind, overlapped with
+    # device compute. Batch-composition changes (admission, finish,
+    # preemption, block-table growth) and per-row extras (guided /
+    # processors / seeded sampling / logprobs / penalties — all need host
+    # work between steps) flush the pipeline back to the sync path, same
+    # fallback shape as the spec/multi-step exclusions. Streaming runs one
+    # step behind on this path (README "Decode pipeline").
+    enable_overlap_decode: bool = True
     # Guided decoding: initial device mask-pool capacity in FSM-state rows.
     # The masked-sampling executable's shape is (decode_bucket, pool_rows);
     # warmup() precompiles it at this capacity, so as long as the total
@@ -259,6 +272,12 @@ class ForwardPassMetrics:
     mixed_steps_total: int = 0
     mixed_prefill_tokens_total: int = 0
     mixed_decode_tokens_total: int = 0
+    # Zero-bubble decode pipeline: steps that ran overlapped (dispatch N+1
+    # before step N's readback) and pipeline flushes back to the sync path
+    # (admission/finish/growth/extras). flushes/steps is the fraction of
+    # pipeline restarts — high ratios mean the traffic mix defeats overlap.
+    overlap_steps_total: int = 0
+    overlap_flushes_total: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -379,13 +398,58 @@ class Scheduler:
                 ),
                 donate_argnums=(1, 2),
             )
+        # tokens/positions/active ride ONE packed [3, bucket] i32 upload and
+        # split in-jit — three small per-step H2D transfers collapsed into
+        # one (each costs ~0.1 ms of dispatch on tunneled devices).
         self._decode_jit = jax.jit(
-            lambda p, k, v, t, pos, bt, act: model.decode(
-                p, self.mc, k, v, t, pos, bt, act, **stats_kw
+            lambda p, k, v, tpa, bt: model.decode(
+                p, self.mc, k, v, tpa[0], tpa[1], bt, tpa[2].astype(bool), **stats_kw
             ),
             donate_argnums=(1, 2),
         )
         self._sample_jit = jax.jit(sample_batch)
+        # Logprobs folded into the sampling dispatch (one executable, one
+        # readback) — the separate compute_logprobs op cost an extra device
+        # round-trip per step for any batch with a logprobs row.
+        from dynamo_tpu.engine.sampling import (
+            guided_sample_batch_logprobs,
+            sample_batch_logprobs,
+        )
+
+        self._sample_lp_jit = jax.jit(sample_batch_logprobs)
+        self._guided_sample_lp_jit = jax.jit(guided_sample_batch_logprobs)
+        # Zero-bubble overlapped decode (llama.decode_sample): fused
+        # decode+sample+state-advance, device-side token feedback. _pipe
+        # holds the in-flight step (see _overlap_step); _tables_cache keeps
+        # the last decode block-table upload so tables cross the wire only
+        # when a table actually changes.
+        self._supports_overlap = hasattr(model, "decode_sample")
+        if self._supports_overlap:
+            self._decode_sample_jit = jax.jit(
+                lambda p, k, v, tpa, bt, te, tk, tp, key: model.decode_sample(
+                    p, self.mc, k, v, tpa, bt, te, tk, tp, key, **stats_kw
+                ),
+                donate_argnums=(1, 2),
+            )
+        self._pipe: Optional[dict] = None
+        self._tables_cache: Optional[tuple] = None
+        self._last_decode_dispatch_t: Optional[float] = None
+        self.overlap_steps_total = 0
+        self.overlap_flushes_total = 0
+        # Deferred-retirement KV rollback: zero the slot the speculative
+        # in-flight step wrote for a row that turned out finished (one
+        # donated in-place scatter — a bare .at[].set would copy the cache).
+        from dynamo_tpu.engine.kv_cache import QuantKv
+
+        def _zero_slot(c, b, o):
+            if isinstance(c, QuantKv):
+                return QuantKv(c.q.at[:, b, o].set(0), c.scale.at[:, b, o].set(0))
+            return c.at[:, b, o].set(jnp.zeros((), c.dtype))
+
+        self._kv_zero_jit = jax.jit(
+            lambda k, v, b, o: (_zero_slot(k, b, o), _zero_slot(v, b, o)),
+            donate_argnums=(0, 1),
+        )
         # Guided decoding (attach_guided): grammar compiler + device mask
         # pool. One fused mask+sample executable serves every guided batch.
         self.guided = None
@@ -615,6 +679,8 @@ class Scheduler:
             mixed_steps_total=self.mixed_steps_total,
             mixed_prefill_tokens_total=self.mixed_prefill_tokens_total,
             mixed_decode_tokens_total=self.mixed_decode_tokens_total,
+            overlap_steps_total=self.overlap_steps_total,
+            overlap_flushes_total=self.overlap_flushes_total,
         )
 
     # --- step loop core (runs in worker thread) -----------------------------
@@ -625,8 +691,19 @@ class Scheduler:
         the iteration is a MIXED step: one dispatch carries the decode
         batch plus up to mixed_prefill_budget prefill tokens, so neither
         phase stalls the other. Otherwise the phase-separated order runs:
-        decode first (ITL), then admit one prefill (TTFT)."""
+        decode first (ITL), then admit one prefill (TTFT).
+
+        With an overlapped decode pipeline in flight (``_pipe``), the
+        iteration instead dispatches step N+1 from the previous step's
+        on-device sampled tokens and retires step N while the device runs —
+        unless a composition change (waiting work, aborts, block growth,
+        finish) forces a flush back to this sync path."""
         outputs: List[tuple] = []
+        if self._pipe is not None:
+            if self._overlap_should_continue():
+                self._overlap_step(outputs)
+                return outputs
+            self._overlap_flush(outputs)
         self._reap_aborted(outputs)
         cand = self._mixed_candidate()
         if cand is not None and not self._wave_preferred() and self._mixed_step(cand, outputs):
@@ -751,23 +828,23 @@ class Scheduler:
         width = self._width_bucket(max(len(s.block_ids) for s in batch))
         tokens = np.zeros((d_bucket,), dtype=np.int32)
         positions = np.zeros((d_bucket,), dtype=np.int32)
-        tables = np.zeros((d_bucket, width), dtype=np.int32)
         active = np.zeros((d_bucket,), dtype=bool)
         for i, s in enumerate(batch):
             tokens[i] = s.all_ids[-1]
             positions[i] = s.total_len - 1
-            tables[i, : len(s.block_ids)] = s.block_ids
             active[i] = True
+        tables = self._decode_tables(batch, d_bucket, width)
 
         mixed_key = (s_bucket, int(p_table.shape[0]), d_bucket, width)
         self.flight.record_exec(
             "mixed", mixed_key + ((has_prefix,) if self._use_flash_prefill else ())
         )
+        self._break_decode_gap()
         with StepTimer() as timer:
             res = self._get_mixed_jit(mixed_key)(
                 self.params, self.cache.k, self.cache.v,
                 jnp.asarray(p_tok), jnp.int32(len(chunk_tokens)), jnp.int32(seq.num_computed),
-                p_table, jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+                p_table, jnp.asarray(tokens), jnp.asarray(positions), tables,
                 jnp.asarray(active), has_prefix,
             )
             logits, self.cache.k, self.cache.v = self._consume_aux(res)
@@ -951,6 +1028,7 @@ class Scheduler:
             tables[i, : len(seq.block_ids)] = seq.block_ids
 
         self.flight.record_exec("admit", (b_bucket, s_bucket, width))
+        self._break_decode_gap()
         with StepTimer() as timer:
             res = self._get_admit_jit((b_bucket, s_bucket, width))(
                 self.params, self.cache.k, self.cache.v,
@@ -1039,6 +1117,7 @@ class Scheduler:
         padded[: len(tokens)] = tokens
         table = self._prefill_table(seq)
 
+        self._break_decode_gap()
         t0 = time.monotonic() if self.sc.itl_budget_ms else None
         with StepTimer() as timer:
             if seq.mm_features is not None:
@@ -1152,6 +1231,7 @@ class Scheduler:
             for width in widths:
                 toks = jnp.zeros((bucket,), jnp.int32)
                 pos = jnp.zeros((bucket,), jnp.int32)
+                tpa = jnp.zeros((3, bucket), jnp.int32)
                 tables = jnp.zeros((bucket, width), jnp.int32)
                 active = jnp.zeros((bucket,), bool)
                 temps = jnp.zeros((bucket,), jnp.float32)
@@ -1160,10 +1240,21 @@ class Scheduler:
                 self.flight.record_exec("decode", (bucket, width))
                 logits, self.cache.k, self.cache.v = self._consume_aux(
                     self._decode_jit(
-                        self.params, self.cache.k, self.cache.v, toks, pos, tables, active
+                        self.params, self.cache.k, self.cache.v, tpa, tables
                     )
                 )
                 count += 1
+                if self.sc.enable_overlap_decode and self._supports_overlap:
+                    # Fused overlap step: same (bucket, width) key space as
+                    # plain decode, so the pipeline never compiles mid-
+                    # traffic (flight-recorder 0-post-warmup gate).
+                    self.flight.record_exec("decode_sample", (bucket, width))
+                    res = self._decode_sample_jit(
+                        self.params, self.cache.k, self.cache.v, tpa, tables,
+                        temps, tks, tps, key,
+                    )
+                    _, _, self.cache.k, self.cache.v = self._consume_aux(res)
+                    count += 1
                 if self.sc.num_scheduler_steps > 1 and self._supports_multi_step:
                     for w, mjit in self._decode_multi_jits.items():
                         self.flight.record_exec("decode_multi", (w, bucket, width))
@@ -1178,6 +1269,22 @@ class Scheduler:
                 jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
                 jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
                 jnp.ones((bucket,), jnp.float32), key, None,
+            )
+            # Fused logprobs variant too: a logprobs row joining a warmed
+            # batch must not compile the sampler mid-traffic.
+            self._sample_lp_jit(
+                jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
+                jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
+                jnp.ones((bucket,), jnp.float32), key, None,
+            )
+            count += 2
+        # Deferred-retirement KV rollback (overlap pipeline): one executable,
+        # warmed against the scratch slot so a finish-mid-pipeline never
+        # compiles under traffic.
+        if self.sc.enable_overlap_decode and self._supports_overlap:
+            self.flight.record_exec("kv_rollback", ())
+            self.cache.k, self.cache.v = self._kv_zero_jit(
+                self.cache.k, self.cache.v, jnp.int32(0), jnp.int32(0)
             )
             count += 1
         # Guided masked-sampling executables: one per decode bucket (plus
@@ -1194,7 +1301,13 @@ class Scheduler:
                     jnp.zeros((bucket,), jnp.float32),
                     jnp.ones((bucket,), jnp.float32), key, None,
                 )
-                count += 1
+                self._guided_sample_lp_jit(
+                    jnp.zeros((bucket, self.mc.vocab_size), jnp.float32), pool,
+                    jnp.zeros((2, bucket), jnp.int32),
+                    jnp.zeros((bucket,), jnp.float32),
+                    jnp.ones((bucket,), jnp.float32), key, None,
+                )
+                count += 2
         prev_bucket = 0
         for bucket in self.sc.prefill_buckets:
             if bucket > self.sc.max_prefill_chunk:
@@ -1324,6 +1437,205 @@ class Scheduler:
         where the target's chunks started."""
         self._draft_catchup(seq, pf_tokens, seq.num_computed)
 
+    # --- zero-bubble overlapped decode --------------------------------------
+    def _decode_tables(self, batch: List[Sequence], bucket: int, width: int) -> jnp.ndarray:
+        """Decode block tables as a device array, re-uploaded ONLY when a
+        table actually changed. Block tables are append-only between
+        composition changes, so steady-state decode re-transferred an
+        identical [bucket, width] i32 array every step; one cached entry
+        (keyed on composition + exact block ids) eliminates that."""
+        key = (bucket, width, tuple(s.request_id for s in batch))
+        blocks = tuple(tuple(s.block_ids) for s in batch)
+        if self._tables_cache is not None:
+            ckey, cblocks, dev = self._tables_cache
+            if ckey == key and cblocks == blocks:
+                return dev
+        tables = np.zeros((bucket, width), dtype=np.int32)
+        for i, s in enumerate(batch):
+            tables[i, : len(s.block_ids)] = s.block_ids
+        dev = jnp.asarray(tables)
+        self._tables_cache = (key, blocks, dev)
+        return dev
+
+    def _record_host_gap(self) -> None:
+        """Host-gap accounting, called right BEFORE a decode-family dispatch:
+        the interval since the previous decode dispatch RETURNED is the
+        bubble the device spent waiting on Python."""
+        if self._last_decode_dispatch_t is not None:
+            self.flight.record_host_gap(time.perf_counter() - self._last_decode_dispatch_t)
+
+    def _note_decode_dispatch(self) -> None:
+        """Called right after a decode-family dispatch call returns (device
+        launched, host free again)."""
+        self._last_decode_dispatch_t = time.perf_counter()
+
+    def _break_decode_gap(self) -> None:
+        """A non-decode dispatch intervened — the next interval is not a
+        decode host gap."""
+        self._last_decode_dispatch_t = None
+
+    def _overlap_row_ok(self, seq: Sequence) -> bool:
+        """Rows needing host work between steps can't ride the pipeline:
+        guided (the FSM must advance before the next mask), processors and
+        penalties (host/history logits edits), seeded sampling (per-row
+        keys), logprobs (separate readback shape), disagg prefill-role
+        exports. Same fallback shape as the spec/multi-step exclusions."""
+        s = seq.sampling
+        return not (
+            seq.aborted
+            or seq.guided is not None
+            or s.logprobs
+            or s.logits_processors
+            or s.has_penalties
+            or (s.seed is not None and s.temperature > 0)
+            or seq.keep_blocks_on_finish
+        )
+
+    def _overlap_start_ok(self, batch: List[Sequence]) -> bool:
+        return (
+            self.sc.enable_overlap_decode
+            and self._supports_overlap
+            and self.draft_params is None
+            and not self.waiting
+            and all(self._overlap_row_ok(s) for s in batch)
+        )
+
+    def _overlap_can_dispatch(self, batch: List[Sequence], positions: List[int]) -> bool:
+        """The next fused dispatch writes KV at each row's input position:
+        every slot must already exist (block-table growth flushes to the
+        sync path, which allocates/preempts there) and stay inside
+        max_seq_len."""
+        bs = self.mc.block_size
+        for seq, p in zip(batch, positions):
+            if p + 1 > len(seq.block_ids) * bs or p >= self.mc.max_seq_len:
+                return False
+        return True
+
+    def _overlap_should_continue(self) -> bool:
+        pipe = self._pipe
+        return (
+            not self.waiting
+            and not any(s.aborted for s in pipe["batch"])
+            and self._overlap_can_dispatch(pipe["batch"], pipe["positions"])
+        )
+
+    def _dispatch_overlap(self, pipe: dict, tpa_dev) -> None:
+        """Issue one fused decode+sample dispatch (async — returns as soon as
+        the device has the work) and stage its outputs in the pipe."""
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng, self._step_counter)
+        self.flight.record_exec("decode_sample", (pipe["bucket"], pipe["width"]))
+        self._record_host_gap()
+        res = self._decode_sample_jit(
+            self.params, self.cache.k, self.cache.v, tpa_dev, pipe["tables"],
+            pipe["temps"], pipe["tks"], pipe["tps"], key,
+        )
+        sampled, next_tpa, self.cache.k, self.cache.v = self._consume_aux(res)
+        self._note_decode_dispatch()
+        pipe["sampled"] = sampled
+        pipe["next_tpa"] = next_tpa
+        self.overlap_steps_total += 1
+
+    def _overlap_start(self, batch: List[Sequence], bucket: int, width: int) -> bool:
+        """Dispatch pipeline step 0. No tokens are retired this iteration —
+        streaming runs one step behind on the overlap path (documented in
+        README "Decode pipeline")."""
+        positions = [s.total_len - 1 for s in batch]
+        if not self._overlap_can_dispatch(batch, positions):
+            return False
+        from dynamo_tpu.engine.sampling import pack_param_rows
+
+        temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
+        tpa = np.zeros((3, bucket), dtype=np.int32)
+        for i, seq in enumerate(batch):
+            tpa[0, i] = seq.all_ids[-1]
+            tpa[1, i] = positions[i]
+            tpa[2, i] = 1
+        pipe = {
+            "batch": batch, "bucket": bucket, "width": width,
+            "tables": self._decode_tables(batch, bucket, width),
+            "temps": jnp.asarray(temps), "tks": jnp.asarray(top_ks),
+            "tps": jnp.asarray(top_ps),
+        }
+        self._dispatch_overlap(pipe, jnp.asarray(tpa))
+        pipe["positions"] = [p + 1 for p in positions]
+        self._pipe = pipe
+        return True
+
+    def _overlap_step(self, outputs: List[tuple]) -> None:
+        """Steady state: dispatch step N+1 from the previous step's ON-DEVICE
+        sampled tokens, THEN read back and retire step N — the readback and
+        all host bookkeeping overlap step N+1's device compute (JAX async
+        dispatch). Exactly ONE blocking sync per steady-state step. A row
+        that turns out finished at step N makes step N+1's token for it
+        speculative garbage — the flush discards it and rolls back its KV
+        write slot."""
+        pipe = self._pipe
+        prev_sampled = pipe["sampled"]
+        # Capture rollback targets BEFORE retirement mutates block tables:
+        # the N+1 dispatch writes each row's last-appended token's KV at
+        # the row's pre-retire total_len.
+        rollback = self._rollback_targets(pipe["batch"])
+        with StepTimer() as timer:
+            self._dispatch_overlap(pipe, pipe["next_tpa"])
+            pipe["positions"] = [p + 1 for p in pipe["positions"]]
+            # Retire step N while N+1 runs on device.
+            sampled_h = np.asarray(prev_sampled)  # the step's one blocking sync
+            finished = False
+            for i, seq in enumerate(pipe["batch"]):
+                self._append_token(seq, int(sampled_h[i]), outputs)
+                if seq.state != SeqState.RUNNING:
+                    finished = True
+        self.flight.record_step("decode", timer.dur, len(pipe["batch"]))
+        if finished:
+            self._overlap_flush(outputs, rollback=rollback)
+
+    def _rollback_targets(self, batch: List[Sequence]) -> List[Optional[tuple]]:
+        """(block, offset) each row's in-flight dispatch writes to — the slot
+        to zero if the row turns out finished while that dispatch runs."""
+        bs = self.mc.block_size
+        out: List[Optional[tuple]] = []
+        for seq in batch:
+            p = seq.total_len
+            out.append((seq.block_ids[p // bs], p % bs) if p < len(seq.block_ids) * bs else None)
+        return out
+
+    def _overlap_flush(self, outputs: List[tuple], rollback: Optional[List] = None) -> None:
+        """Absorb the in-flight step and return to the sync path. Rows still
+        running keep their token (the in-flight step computed exactly what
+        the sync path would have — no wasted work); rows that finished at
+        the previous retire discard their speculative token and get the KV
+        slot the in-flight step wrote zeroed (same shape as the preemption-
+        resume recompute: the device state must not outrun the host's
+        account of the sequence). ``rollback`` is only passed by
+        _overlap_step's finish path — on a plain composition flush every
+        row is still running and nothing rolls back."""
+        pipe, self._pipe = self._pipe, None
+        self.overlap_flushes_total += 1
+        sampled_h = np.asarray(pipe["sampled"])
+        for i, seq in enumerate(pipe["batch"]):
+            if seq.state != SeqState.RUNNING:
+                # Rollback applies ONLY to rows that finished at the previous
+                # retire (a row preempted by a batchmate's capacity growth
+                # below lands here WAITING — its blocks are already released
+                # and possibly re-owned, nothing to zero).
+                if (
+                    rollback is not None and rollback[i] is not None
+                    and seq.state == SeqState.FINISHED and not seq.aborted
+                ):
+                    blk, off = rollback[i]
+                    self.flight.record_exec("kv_rollback", ())
+                    self.cache.k, self.cache.v = self._kv_zero_jit(
+                        self.cache.k, self.cache.v, jnp.int32(blk), jnp.int32(off)
+                    )
+                continue
+            if seq.aborted:
+                continue  # _reap_aborted finishes it without the extra token
+            self._ensure_block_capacity(seq)
+            if seq.state != SeqState.RUNNING:
+                continue
+            self._append_token(seq, int(sampled_h[i]), outputs)
+
     def _decode_step(self) -> List[tuple]:
         outputs: List[tuple] = []
         n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
@@ -1374,30 +1686,27 @@ class Scheduler:
         # them all.
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
 
-        tokens = np.zeros((bucket,), dtype=np.int32)
-        positions = np.zeros((bucket,), dtype=np.int32)
-        tables = np.zeros((bucket, width), dtype=np.int32)
-        active = np.zeros((bucket,), dtype=bool)
+        # Zero-bubble pipeline entry: no-extras batches with no waiting work
+        # hand off to the overlapped fused-step loop (tokens stream one step
+        # behind; this iteration emits nothing).
+        if self._overlap_start_ok(batch) and self._overlap_start(batch, bucket, width):
+            return outputs
 
+        tpa = np.zeros((3, bucket), dtype=np.int32)
         for i, seq in enumerate(batch):
-            tokens[i] = seq.all_ids[-1]
-            positions[i] = seq.total_len - 1  # write slot of the current token
-            tables[i, : len(seq.block_ids)] = seq.block_ids
-            active[i] = True
+            tpa[0, i] = seq.all_ids[-1]
+            tpa[1, i] = seq.total_len - 1  # write slot of the current token
+            tpa[2, i] = 1
+        tables = self._decode_tables(batch, bucket, width)
 
         self.flight.record_exec("decode", (bucket, width))
         with StepTimer() as timer:
-            logits, self.cache.k, self.cache.v = self._consume_aux(
-                self._decode_jit(
-                    self.params,
-                    self.cache.k,
-                    self.cache.v,
-                    jnp.asarray(tokens),
-                    jnp.asarray(positions),
-                    jnp.asarray(tables),
-                    jnp.asarray(active),
-                )
+            self._record_host_gap()
+            res = self._decode_jit(
+                self.params, self.cache.k, self.cache.v, jnp.asarray(tpa), tables
             )
+            self._note_decode_dispatch()
+            logits, self.cache.k, self.cache.v = self._consume_aux(res)
             self._finish_decode_rows(batch, bucket, logits, outputs)
         self.flight.record_step("decode", timer.dur, len(outputs))
         return outputs
@@ -1451,6 +1760,12 @@ class Scheduler:
                 key, jnp.asarray(seeds), jnp.asarray(poss_out), jnp.asarray(has_seed)
             )
         temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
+        # Logprobs fold into the SAME sampling dispatch when any row wants
+        # them (sampling.sample_batch_logprobs): one executable, one
+        # readback — previously a separate compute_logprobs device op plus
+        # its own sync per step.
+        want_lp = any(seq.sampling.logprobs for seq in batch)
+        logprobs_np = None
         if any(seq.guided is not None for seq in batch):
             # Guided rows: gather each row's FSM-state mask from the shared
             # device pool inside the fused mask+sample dispatch. Unguided
@@ -1463,10 +1778,24 @@ class Scheduler:
                 if seq.guided is not None:
                     k_rows[1, i] = seq.guided.row_id
             self.flight.record_exec("guided_sample", (bucket, int(pool.shape[0])))
-            sampled = np.asarray(
-                self._guided_sample_jit(
-                    logits, pool, jnp.asarray(k_rows),
-                    jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
+            if want_lp:
+                sampled, logprobs_np = jax.device_get(
+                    self._guided_sample_lp_jit(
+                        logits, pool, jnp.asarray(k_rows),
+                        jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
+                    )
+                )
+            else:
+                sampled = np.asarray(
+                    self._guided_sample_jit(
+                        logits, pool, jnp.asarray(k_rows),
+                        jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
+                    )
+                )
+        elif want_lp:
+            sampled, logprobs_np = jax.device_get(
+                self._sample_lp_jit(
+                    logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
                 )
             )
         else:
@@ -1475,11 +1804,6 @@ class Scheduler:
                     logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
                 )
             )
-        logprobs_np = None
-        if any(seq.sampling.logprobs for seq in batch):
-            from dynamo_tpu.engine.sampling import compute_logprobs
-
-            logprobs_np = np.asarray(compute_logprobs(logits, jnp.asarray(sampled)))
 
         for i, seq in enumerate(batch):
             if seq.state != SeqState.RUNNING:
@@ -1506,14 +1830,24 @@ class Scheduler:
             max(1, seq.stop.max_tokens - len(seq.output_ids)) for seq in batch
         )
         steps = next((w for w in self._window_rungs if w >= rem), self._window_rungs[-1])
-        if self.waiting and self.sc.window_waiting_cap:
-            steps = min(
-                steps,
-                next(
-                    (w for w in self._window_rungs if w >= self.sc.window_waiting_cap),
-                    self._window_rungs[-1],
-                ),
+        if self.sc.window_waiting_cap:
+            cap_rung = next(
+                (w for w in self._window_rungs if w >= self.sc.window_waiting_cap),
+                self._window_rungs[-1],
             )
+            if self.waiting:
+                steps = min(steps, cap_rung)
+            # ``rem`` is the MAX remaining across the batch, so one long
+            # request would drag short-remaining batchmates through an
+            # oversized window — every step past a batchmate's stop is
+            # computed then trimmed. When any batchmate is within a rung of
+            # finishing, clamp to the same cap rung: the short row wastes at
+            # most cap_rung-1 trimmed steps instead of the full window.
+            rem_min = min(
+                max(1, seq.stop.max_tokens - len(seq.output_ids)) for seq in batch
+            )
+            if rem_min <= cap_rung:
+                steps = min(steps, cap_rung)
         bs = self.mc.block_size
         # Reserve blocks for the whole window up front (+1 for the next
         # iteration's write slot, matching _ensure_block_capacity).
@@ -1535,26 +1869,27 @@ class Scheduler:
 
         tokens = np.zeros((bucket,), dtype=np.int32)
         positions = np.zeros((bucket,), dtype=np.int32)
-        tables = np.zeros((bucket, width), dtype=np.int32)
         active = np.zeros((bucket,), dtype=bool)
         temps, top_ks, top_ps = pack_param_rows([s.sampling for s in batch], bucket)
         for i, seq in enumerate(batch):
             tokens[i] = seq.all_ids[-1]
             positions[i] = seq.total_len - 1
-            tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
+        tables = self._decode_tables(batch, bucket, width)
 
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
         self.flight.record_exec("decode_multi", (steps, bucket, width))
         n0 = len(outputs)
         with StepTimer() as timer:
+            self._record_host_gap()
             res = self._decode_multi_jits[steps](
                 self.params, self.cache.k, self.cache.v,
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(tokens), jnp.asarray(positions), tables,
                 jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), key,
             )
+            self._note_decode_dispatch()
             toks_out, self.cache.k, self.cache.v = self._consume_aux(res)
             sampled = np.asarray(toks_out)  # [steps, bucket] — the one host sync
 
@@ -1599,6 +1934,7 @@ class Scheduler:
         B = bucket
         width = self._width_bucket(max(len(seq.block_ids) for seq in batch))
         self.flight.record_exec("spec", (gamma, B, width))
+        self._break_decode_gap()
         n0 = len(outputs)
         t_round = time.perf_counter()
         tables = np.zeros((B, width), dtype=np.int32)

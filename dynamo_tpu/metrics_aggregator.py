@@ -35,6 +35,8 @@ COUNTER_KEYS = (
     "request_total", "preemptions_total",
     "moe_dropped_total", "moe_assignments_total",
     "mixed_steps_total", "mixed_prefill_tokens_total", "mixed_decode_tokens_total",
+    "overlap_steps_total", "overlap_flushes_total",
+    "decode_host_gap_events_total", "decode_host_gap_seconds_total",
     "compiles_total", "compiles_after_warmup_total",
     "guided_requests_total", "guided_grammar_compiles_total",
     "guided_grammar_compile_seconds_total",

@@ -123,6 +123,46 @@ def test_counter_delta_and_restart_semantics():
     assert line.endswith(" 17.0"), line
 
 
+def test_overlap_decode_metrics_render_in_all_roles():
+    """The zero-bubble decode pipeline's counters (overlap steps/flushes)
+    and host-gap histogram must flow engine → stats → aggregator →
+    Prometheus: keys declared in COUNTER_KEYS, emitted by the flight
+    recorder / scheduler wire dicts, and rendered as rate()-able counters."""
+    from dynamo_tpu.engine.flight_recorder import GAP_BUCKETS, FlightRecorder
+    from dynamo_tpu.engine.scheduler import ForwardPassMetrics
+
+    new_keys = (
+        "overlap_steps_total", "overlap_flushes_total",
+        "decode_host_gap_events_total", "decode_host_gap_seconds_total",
+    )
+    for key in new_keys:
+        assert key in COUNTER_KEYS, f"{key} missing from aggregator COUNTER_KEYS"
+
+    # Flight recorder emits the gap histogram's sum/count counters...
+    fr = FlightRecorder()
+    fr.record_host_gap(0.003)
+    stats = fr.to_stats()
+    assert stats["decode_host_gap_events_total"] == 1
+    assert stats["decode_host_gap_seconds_total"] > 0
+    # ...and the full histogram uses gap-scale buckets (sub-ms floor), not
+    # the request-latency defaults.
+    buckets, counts = fr.histogram("host_gap")
+    assert buckets == GAP_BUCKETS and buckets[0] <= 0.0005
+    assert len(counts) == len(buckets) + 1 and sum(counts) == 1
+    assert fr.gap_percentile(0.5) <= 0.005 <= fr.gap_percentile(0.99) * 10
+
+    # Scheduler metrics carry the overlap counters on the wire.
+    wire = ForwardPassMetrics().to_wire()
+    assert "overlap_steps_total" in wire and "overlap_flushes_total" in wire
+
+    # Aggregator renders them as Counter families (rate()-able).
+    fams = parse_families(aggregator_registry().render().decode())
+    for key in new_keys:
+        assert fams.get(f"dynamo_component_worker_{key}", {}).get("type") == "counter", (
+            f"{key} not rendered as a counter by the aggregator"
+        )
+
+
 def test_get_or_create_rejects_label_mismatch_on_reuse():
     """Regression: sibling registries reusing a collector with a DIFFERENT
     label set must get a clear error at declaration time, not a confusing

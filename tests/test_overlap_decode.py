@@ -1,0 +1,278 @@
+"""Zero-bubble overlapped decode: the fused decode+sample pipeline with
+on-device token feedback must be token-exact vs the sync path (greedy
+sequences are a pure function of the prompt, whatever the scheduling), flush
+correctly on composition changes, retire one step behind with KV-slot
+rollback for sequences that finish mid-pipeline, and hold the steady-state
+host-sync bound the whole feature exists for (≤1 blocking sync per step)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+from dynamo_tpu.runtime.engine import Context
+
+CFG = get_config("tiny").replace(max_seq_len=4096)
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def mk_sched(overlap: bool, **kw) -> Scheduler:
+    return Scheduler(
+        CFG, PARAMS,
+        SchedulerConfig(
+            num_blocks=256, max_running=8,
+            prefill_buckets=[32, 64], decode_buckets=[1, 2, 4, 8],
+            num_scheduler_steps=1, enable_prefix_caching=False,
+            enable_overlap_decode=overlap, **kw,
+        ),
+        dtype=jnp.float32,
+    )
+
+
+def add(sched, rid, prompt, max_tokens):
+    sched.add_request(
+        rid, prompt, SamplingParams(temperature=0.0),
+        StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+
+
+def drain(sched, hook=None) -> dict:
+    """Run to completion, returning {request_id: [token, ...]}."""
+    out: dict = {}
+    for _ in range(4000):
+        if not sched.has_work():
+            break
+        for seq, o in sched.step():
+            if o.token_id >= 0:
+                out.setdefault(seq.request_id, []).append(o.token_id)
+        if hook is not None:
+            hook(sched)
+    assert not sched.has_work(), "scheduler did not drain"
+    return out
+
+
+def test_overlap_matches_sync_greedy_multi_request():
+    reqs = [(f"r{i}", list(range(3 + i, 23 + i)), 20 + 7 * i) for i in range(4)]
+
+    def run(overlap):
+        sched = mk_sched(overlap)
+        for rid, prompt, mt in reqs:
+            add(sched, rid, prompt, mt)
+        toks = drain(sched)
+        return sched, toks
+
+    s_on, on = run(True)
+    s_off, off = run(False)
+    assert on == off
+    assert all(len(on[rid]) == mt for rid, _, mt in reqs)
+    assert s_on.overlap_steps_total > 0
+    assert s_off.overlap_steps_total == 0
+
+
+def test_finish_mid_pipeline_rolls_back_kv_slot():
+    """One request stops while its batchmate keeps decoding: the speculative
+    in-flight step's token for the stopped row is discarded, the KV slot it
+    wrote is zeroed, and the survivor's cache contents stay byte-identical
+    to the sync path."""
+    bs = CFG.block_size
+
+    def run(overlap):
+        sched = mk_sched(overlap)
+    p = 20 + 6 - 1  # short's final token slot: prompt + max_tokens - 1
+
+    def run(overlap):
+        sched = mk_sched(overlap)
+        add(sched, "short", list(range(5, 25)), 6)
+        add(sched, "long", list(range(7, 27)), 40)
+        blocks: dict = {}
+        slot = [None]
+
+        def snapshot(s):
+            for rid in ("short", "long"):
+                seq = s.by_id.get(rid)
+                if seq is not None and seq.block_ids:
+                    blocks[rid] = list(seq.block_ids)
+            # The step "short" finished on: read its speculative slot NOW,
+            # before the allocator hands the released blocks to "long".
+            if slot[0] is None and "short" not in s.by_id and "short" in blocks:
+                blk = blocks["short"][p // bs]
+                slot[0] = np.asarray(s.cache.k[:, blk, p % bs])
+        toks = drain(sched, hook=snapshot)
+        return sched, toks, blocks, slot[0]
+
+    s_on, on, blk_on, slot_on = run(True)
+    s_off, off, blk_off, slot_off = run(False)
+    assert on == off and len(on["short"]) == 6 and len(on["long"]) == 40
+    assert s_on.overlap_flushes_total >= 1  # the finish forced a flush
+
+    # The allocator is deterministic and both runs made identical
+    # allocations, so per-request block ids line up run-to-run.
+    assert blk_on == blk_off
+
+    # Rollback: "short" finished at some step N with step N+1 in flight;
+    # that in-flight dispatch wrote short's last token's KV at position
+    # total_len-1 — a slot the sync path never writes (a finished row's
+    # last token is never fed back). Zeroing restores sync parity.
+    np.testing.assert_array_equal(slot_on, 0.0)
+    np.testing.assert_array_equal(slot_off, 0.0)
+    k_on = np.asarray(s_on.cache.k)
+    k_off = np.asarray(s_off.cache.k)
+
+    # Survivor parity: every KV row "long" actually wrote matches sync.
+    # (Slots past the written extent hold stale pre-release data in the
+    # sync run vs rollback zeros in the overlap run — released-block
+    # garbage neither path ever reads.)
+    total = 20 + 40
+    for pos in range(total - 1):
+        blk = blk_on["long"][pos // bs]
+        np.testing.assert_allclose(
+            k_on[:, blk, pos % bs], k_off[:, blk, pos % bs], rtol=1e-6, atol=1e-6,
+            err_msg=f"long KV row at position {pos} diverged",
+        )
+    # Long's own final slot: the overlap run zeroed it at finish-flush.
+    final_blk = blk_on["long"][(total - 1) // bs]
+    np.testing.assert_array_equal(k_on[:, final_blk, (total - 1) % bs], 0.0)
+
+
+def test_flush_on_admission_mid_pipeline():
+    """A request arriving while the pipeline runs must flush it (the batch
+    composition changes), admit the newcomer, and keep every token stream
+    exact."""
+    sched = mk_sched(True)
+    for i in range(3):
+        add(sched, f"r{i}", list(range(2 + i, 22 + i)), 30)
+    late_added = [False]
+    flushes_at_add = [0]
+
+    def hook(s):
+        if not late_added[0] and s._pipe is not None:
+            flushes_at_add[0] = s.overlap_flushes_total
+            add(s, "late", list(range(40, 60)), 12)
+            late_added[0] = True
+
+    on = drain(sched, hook=hook)
+    assert late_added[0]
+    assert sched.overlap_flushes_total > flushes_at_add[0]
+    assert len(on["late"]) == 12
+
+    sync = mk_sched(False)
+    for i in range(3):
+        add(sync, f"r{i}", list(range(2 + i, 22 + i)), 30)
+    add(sync, "late", list(range(40, 60)), 12)
+    assert drain(sync) == on  # greedy streams are scheduling-invariant
+
+
+def test_steady_state_single_blocking_sync(monkeypatch):
+    """The pipeline's whole point: once overlapped, each step() performs at
+    most ONE blocking device sync (the previous step's token readback) and
+    zero jax.device_get calls — counted by instrumenting the only two
+    blocking-readback entry points the scheduler uses."""
+    import dynamo_tpu.engine.scheduler as sched_mod
+
+    sched = mk_sched(True)
+    for i in range(4):
+        add(sched, f"r{i}", list(range(3 + i, 23 + i)), 200)
+    for _ in range(60):
+        if sched._pipe is not None:
+            break
+        sched.step()
+    assert sched._pipe is not None, "pipeline never engaged"
+    sched.step()  # one steady-state step before instrumenting
+
+    counter = {"n": 0}
+    real_asarray = np.asarray
+    real_device_get = jax.device_get
+
+    def counting_asarray(a, *args, **kw):
+        if isinstance(a, jax.Array):
+            counter["n"] += 1
+        return real_asarray(a, *args, **kw)
+
+    def counting_device_get(x, *args, **kw):
+        counter["n"] += 1
+        return real_device_get(x, *args, **kw)
+
+    monkeypatch.setattr(sched_mod.np, "asarray", counting_asarray)
+    monkeypatch.setattr(sched_mod.jax, "device_get", counting_device_get)
+    steps, tokens = 10, 0
+    try:
+        for _ in range(steps):
+            outs = sched.step()
+            assert sched._pipe is not None, "pipeline flushed mid steady-state"
+            tokens += sum(1 for _, o in outs if o.token_id >= 0)
+    finally:
+        monkeypatch.undo()
+    assert tokens == steps * 4  # one token per row per step, one step behind
+    assert counter["n"] <= steps, (
+        f"{counter['n']} blocking syncs over {steps} steady-state steps"
+    )
+    drain(sched)
+
+
+async def test_overlap_zero_post_warmup_compiles():
+    """Warmed engine serving overlap traffic (incl. a finish-mid-pipeline
+    rollback) compiles nothing new — the flight-recorder gate every decode
+    path must hold."""
+    engine = TpuEngine.build(
+        EngineArgs(
+            model="tiny", dtype="float32", eos_token_ids=[0],
+            scheduler=SchedulerConfig(
+                num_blocks=64, prefill_buckets=[16, 32, 64],
+                decode_buckets=[1, 2, 4], num_scheduler_steps=1,
+            ),
+            warmup_ctx=64,
+        )
+    )
+
+    async def one(start, max_tokens):
+        req = {"token_ids": list(range(start, start + 20)),
+               "sampling_options": {"temperature": 0},
+               "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True}}
+        out = []
+        async for frame in engine.generate(req, Context()):
+            out.extend(frame.get("token_ids") or [])
+        return out
+
+    try:
+        # Sequential requests (same discipline as the tracing compile test:
+        # wave/mixed admission keys compile lazily BY DESIGN for uncommon
+        # shapes — the subject here is the overlap executables). Each
+        # request decodes alone through the pipeline and finishes mid-
+        # pipeline, so the rollback executable runs too.
+        outs = [await one(0, 6), await one(40, 12), await one(80, 12)]
+        stats = engine.stats_handler()
+        assert stats["compiles_after_warmup_total"] == 0, (
+            f"compiled mid-traffic: {engine.scheduler.flight.post_warmup_keys}"
+        )
+        assert stats["overlap_steps_total"] > 0
+        assert stats["decode_host_gap_events_total"] > 0
+        assert [len(o) for o in outs] == [6, 12, 12]
+    finally:
+        await engine.stop()
+
+
+def test_overlap_streams_one_step_behind():
+    """Documented semantics: the pipeline's first dispatch emits nothing
+    (its tokens retire with the next step); steady steps emit one token per
+    row."""
+    sched = mk_sched(True)
+    add(sched, "r0", list(range(4, 24)), 50)
+    while sched.waiting:
+        sched.step()
+    assert sched._pipe is None or True  # admission may already have stepped
+    # Find the starting step: pipeline engages and emits nothing.
+    for _ in range(20):
+        before = sched._pipe
+        outs = sched.step()
+        if before is None and sched._pipe is not None:
+            assert outs == []  # one-step lag on pipeline start
+            break
+    outs = sched.step()  # steady state retires exactly one step
+    assert sum(1 for _, o in outs if o.token_id >= 0) == 1
+    drain(sched)
