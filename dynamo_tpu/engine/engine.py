@@ -354,6 +354,11 @@ class TpuEngine:
                         logprobs.append(out.logprob)
                     if out.queue_s is not None and "queue_s" not in frame:
                         frame["queue_s"] = out.queue_s
+                    if out.cached_tokens is not None and "cached_tokens" not in frame:
+                        # Prefix-cache reuse (first frame): prompt tokens
+                        # served from resident KV — flows to OpenAI
+                        # usage.prompt_tokens_details and router accounting.
+                        frame["cached_tokens"] = out.cached_tokens
                     if out.finished:
                         frame["finish_reason"] = out.finish_reason
                 if logprobs:
@@ -408,6 +413,20 @@ class TpuEngine:
             # histogram itself rides flight.to_stats() below.
             "overlap_steps_total": m.overlap_steps_total,
             "overlap_flushes_total": m.overlap_flushes_total,
+            # Automatic prefix caching: skipped prompt tokens + the block
+            # hit/miss/evict/onboard account (Grafana "Prefix cache" rows).
+            "cached_tokens_total": m.cached_tokens_total,
+            "prefix_hit_blocks_total": m.prefix_hit_blocks_total,
+            "prefix_miss_blocks_total": m.prefix_miss_blocks_total,
+            "prefix_evicted_blocks_total": m.prefix_evicted_blocks_total,
+            "prefix_onboard_total": m.prefix_onboard_total,
+            # First-token latency decomposition: queue (arrival→admission)
+            # and prefill (admission→first token) sums — with the flight
+            # recorder's step histograms these give the bench http sweep
+            # its queue/prefill/decode breakdown.
+            "queue_wait_seconds_total": round(self.scheduler.queue_wait_s_total, 6),
+            "prefill_wait_seconds_total": round(self.scheduler.prefill_wait_s_total, 6),
+            "first_tokens_total": self.scheduler.first_tokens_total,
         }
         # Flight recorder: per-phase step/token counters + the XLA compile
         # tracker (compiles_after_warmup_total > 0 in steady state is the

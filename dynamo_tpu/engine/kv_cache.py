@@ -183,6 +183,11 @@ class BlockAllocator:
         # block is evicted for reuse — the copy-out point for the G1→G2
         # cascade (content is still intact at call time).
         self.on_evict: Optional[Callable[[int, int], None]] = None
+        # Prefix-cache accounting (monotonic; surfaced through worker stats
+        # → aggregator counters → the Grafana hit-rate panels).
+        self.hit_blocks_total = 0
+        self.miss_blocks_total = 0
+        self.evicted_blocks_total = 0
 
     # --- queries ------------------------------------------------------------
     @property
@@ -211,7 +216,15 @@ class BlockAllocator:
                 break
             self._acquire(bid)
             matched.append(bid)
+        self.hit_blocks_total += len(matched)
+        self.miss_blocks_total += len(block_hashes) - len(matched)
         return matched
+
+    def ref_count(self, bid: int) -> int:
+        """Live references on a block (0 = cached/free). The scheduler's
+        copy-on-write check: a matched block with other holders must not be
+        written in place."""
+        return self._refcount.get(bid, 0)
 
     # --- allocation ---------------------------------------------------------
     def allocate(self, n: int) -> List[int]:
@@ -227,6 +240,7 @@ class BlockAllocator:
                     h = self._hash_of.pop(bid)
                     del self._by_hash[h]
                     removed_hashes.append(h)
+                    self.evicted_blocks_total += 1
                     if self.on_evict is not None:
                         self.on_evict(bid, h)  # offload cascade copy-out
                 else:
@@ -254,8 +268,16 @@ class BlockAllocator:
 
     def release(self, block_ids: Sequence[int]) -> None:
         """Drop a reference; refcount-0 blocks become cached (if hashed) or
-        free (if not)."""
-        for bid in block_ids:
+        free (if not).
+
+        Blocks enter the LRU in REVERSE list order. Block tables are
+        chain-ordered (prefix head first), and a chained prefix is only
+        matchable up to its first missing block — evicting a chain HEAD
+        destroys the whole prefix while its tail blocks sit uselessly in
+        cache. Reversing makes eviction consume chains tail-first: matches
+        degrade to shorter prefixes instead of zero, and per-request suffix
+        blocks (unique, never re-matched) go before shared prefix heads."""
+        for bid in reversed(list(block_ids)):
             c = self._refcount.get(bid, 0) - 1
             if c > 0:
                 self._refcount[bid] = c
@@ -308,6 +330,7 @@ class BlockAllocator:
             removed.append(h)
             self._free.append(bid)
         self._cached_lru.clear()
+        self.evicted_blocks_total += n
         if removed and self.on_event:
             self.on_event(KvEvent(kind="removed", block_hashes=removed))
         return n

@@ -111,6 +111,11 @@ class StepOutput:
     # arrival and engine admission (the saturation signal the SLA planner
     # inverts; ref: http_queue_guard, http/service/metrics.rs).
     queue_s: Optional[float] = None
+    # Set on the first token only: prompt tokens whose KV came from the
+    # prefix cache instead of prefill compute — the engine's ground truth
+    # behind OpenAI ``usage.prompt_tokens_details.cached_tokens`` and the
+    # KV router's reuse accounting.
+    cached_tokens: Optional[int] = None
 
 
 @dataclass
@@ -127,6 +132,7 @@ class Sequence:
     num_computed: int = 0  # prompt tokens whose KV is in cache
     block_hashes: List[int] = field(default_factory=list)
     num_cached_blocks: int = 0  # prefix blocks reused from cache
+    cached_tokens: int = 0  # prompt tokens skipped by the prefix cache
     out_queue: "asyncio.Queue[Optional[StepOutput]]" = field(default_factory=asyncio.Queue)
     arrival_ts: float = field(default_factory=time.monotonic)
     admitted_ts: Optional[float] = None  # first engine work (queue-time end)
@@ -173,9 +179,15 @@ class Sequence:
 @dataclass
 class SchedulerConfig:
     num_blocks: int = 512
-    max_running: int = 16  # decode slots
+    # Decode slots. Default 16→32 (r6): the bench http sweep's first-token
+    # breakdown at concurrency 64 put 292 ms of the 393 ms TTFT p50 in the
+    # ADMISSION QUEUE with 16 slots (prefill wait was 20 ms) — the knee was
+    # queueing, not compute; 32 slots measured +53% req/s and halved p50,
+    # with OutOfBlocks backpressure still guarding memory. Size num_blocks
+    # to the expected context × slots as before.
+    max_running: int = 32
     prefill_buckets: List[int] = field(default_factory=lambda: [32, 64, 128, 256, 512, 1024, 2048])
-    decode_buckets: List[int] = field(default_factory=lambda: [1, 2, 4, 8, 16])
+    decode_buckets: List[int] = field(default_factory=lambda: [1, 2, 4, 8, 16, 32])
     max_prefill_chunk: int = 2048
     enable_prefix_caching: bool = True
     # Disagg prefill role: how long finished-prefill KV blocks may await the
@@ -278,6 +290,15 @@ class ForwardPassMetrics:
     # pipeline restarts — high ratios mean the traffic mix defeats overlap.
     overlap_steps_total: int = 0
     overlap_flushes_total: int = 0
+    # Automatic prefix caching: prompt tokens served from resident KV
+    # instead of prefill compute, and the block-granular hit/miss/evict/
+    # onboard account behind them. hit/(hit+miss) is the block hit rate;
+    # onboard counts DRAM/disk-tier blocks copied back into HBM on a hit.
+    cached_tokens_total: int = 0
+    prefix_hit_blocks_total: int = 0
+    prefix_miss_blocks_total: int = 0
+    prefix_evicted_blocks_total: int = 0
+    prefix_onboard_total: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -450,6 +471,33 @@ class Scheduler:
             lambda k, v, b, o: (_zero_slot(k, b, o), _zero_slot(v, b, o)),
             donate_argnums=(0, 1),
         )
+        # Prefix-cache copy-on-write: duplicate one block's contents into a
+        # private block (full-cover hits recompute only the LAST prompt
+        # token, whose KV write would otherwise land in a block other
+        # sequences still reference). Donated in-place scatter, one
+        # executable for every (src, dst) pair; warmed against scratch.
+
+        def _copy_block_arr(c, src, dst):
+            if isinstance(c, QuantKv):
+                return QuantKv(c.q.at[:, dst].set(c.q[:, src]), c.scale.at[:, dst].set(c.scale[:, src]))
+            return c.at[:, dst].set(c[:, src])
+
+        self._kv_copy_jit = jax.jit(
+            lambda k, v, s, d: (_copy_block_arr(k, s, d), _copy_block_arr(v, s, d)),
+            donate_argnums=(0, 1),
+        )
+        # Prefix-cache accounting: reuse is only "automatic" if it is
+        # visible — cached_tokens flows request-level (StepOutput → usage)
+        # and these totals flow through stats → aggregator → Grafana.
+        self.cached_tokens_total = 0
+        self.cow_blocks_total = 0
+        self.prefix_onboard_total = 0
+        # First-token latency decomposition (bench http-sweep breakdown):
+        # queue (arrival→admission) and prefill (admission→first token)
+        # sums over finished first tokens.
+        self.queue_wait_s_total = 0.0
+        self.prefill_wait_s_total = 0.0
+        self.first_tokens_total = 0
         # Guided decoding (attach_guided): grammar compiler + device mask
         # pool. One fused mask+sample executable serves every guided batch.
         self.guided = None
@@ -681,6 +729,11 @@ class Scheduler:
             mixed_decode_tokens_total=self.mixed_decode_tokens_total,
             overlap_steps_total=self.overlap_steps_total,
             overlap_flushes_total=self.overlap_flushes_total,
+            cached_tokens_total=self.cached_tokens_total,
+            prefix_hit_blocks_total=a.hit_blocks_total,
+            prefix_miss_blocks_total=a.miss_blocks_total,
+            prefix_evicted_blocks_total=a.evicted_blocks_total,
+            prefix_onboard_total=self.prefix_onboard_total,
         )
 
     # --- step loop core (runs in worker thread) -----------------------------
@@ -862,6 +915,7 @@ class Scheduler:
         )
 
         seq.num_computed += len(chunk_tokens)
+        self._register_full_blocks(seq)  # chunk's completed blocks go live
         if seq.num_computed < len(pf_tokens):
             return True  # more chunks ride later steps
         self.waiting.remove(seq)
@@ -1002,9 +1056,11 @@ class Scheduler:
             # acquired here must be returned first).
             for seq in admitted:
                 self.allocator.release(seq.block_ids)
+                self.cached_tokens_total -= seq.cached_tokens
                 seq.block_ids = []
                 seq.num_cached_blocks = 0
                 seq.num_computed = 0
+                seq.cached_tokens = 0
                 seq.state = SeqState.WAITING
             return False
 
@@ -1064,21 +1120,44 @@ class Scheduler:
             if self.sc.enable_prefix_caching and seq.mm_features is None:
                 seq.block_hashes = extend_block_hashes([], pf_tokens, bs)
                 matched = self._match_prefix_tiers(seq)
-                # Keep at least one token to prefill so we always produce logits.
+                # At least one token must prefill so logits exist. A FULL
+                # cover keeps every matched block and recomputes only the
+                # last token — but its KV write lands inside the final
+                # matched block, which other sequences may still reference:
+                # copy-on-write it into a private block. A sole-held block
+                # (refcount 1 = just us) is written in place instead — the
+                # recomputed row is bit-identical, so no copy is needed.
                 if matched and len(matched) * bs >= len(pf_tokens):
-                    self.allocator.release([matched[-1]])
-                    matched = matched[:-1]
+                    last = matched[-1]
+                    if self.allocator.ref_count(last) > 1:
+                        try:
+                            (cow,) = self.allocator.allocate(1)
+                        except OutOfBlocksError:
+                            # No room for the private copy: degrade to
+                            # recomputing the whole last block (still an
+                            # n-1 block hit).
+                            self.allocator.release([last])
+                            matched = matched[:-1]
+                        else:
+                            self._copy_block(last, cow)
+                            self.allocator.release([last])
+                            matched[-1] = cow
+                            self.cow_blocks_total += 1
                 seq.block_ids = list(matched)
                 seq.num_cached_blocks = len(matched)
-                seq.num_computed = len(matched) * bs
+                seq.num_computed = min(len(matched) * bs, len(pf_tokens) - 1)
+                seq.cached_tokens = seq.num_computed
+                self.cached_tokens_total += seq.cached_tokens
             needed = (total_tokens + bs - 1) // bs - len(seq.block_ids)
             if needed > 0:
                 seq.block_ids.extend(self.allocator.allocate(needed))
         except OutOfBlocksError:
             self.allocator.release(seq.block_ids)
+            self.cached_tokens_total -= seq.cached_tokens
             seq.block_ids = []
             seq.num_cached_blocks = 0
             seq.num_computed = 0
+            seq.cached_tokens = 0
             raise
         seq.state = SeqState.PREFILL
         if seq.admitted_ts is None:
@@ -1167,6 +1246,7 @@ class Scheduler:
                 0.7 * self._prefill_tok_s + 0.3 * rate
             )
         seq.num_computed += len(tokens)
+        self._register_full_blocks(seq)  # chunk's completed blocks go live
         self._draft_catchup_prefill(seq, pf_tokens)
 
         if seq.num_computed < len(pf_tokens):
@@ -1287,6 +1367,15 @@ class Scheduler:
                 self.cache.k, self.cache.v, jnp.int32(0), jnp.int32(0)
             )
             count += 1
+        # Prefix-cache copy-on-write block copy: one executable, warmed
+        # against the scratch block so a full-cover hit under traffic never
+        # compiles (0-post-warmup invariant with prefix caching enabled).
+        if self.sc.enable_prefix_caching:
+            self.flight.record_exec("kv_block_copy", ())
+            self.cache.k, self.cache.v = self._kv_copy_jit(
+                self.cache.k, self.cache.v, jnp.int32(0), jnp.int32(0)
+            )
+            count += 1
         # Guided masked-sampling executables: one per decode bucket (plus
         # the bucket-1 prefill-tail sampler) at the current pool capacity —
         # guided rows joining a warmed batch then compile nothing.
@@ -1316,6 +1405,11 @@ class Scheduler:
             # the shortest prompt that maps here (prev_bucket+1 tokens),
             # bucketed by _prefill_table's rung rule (16 floor).
             min_w = max(16, width_bucket((prev_bucket + 1 + bs - 1) // bs, self.max_blocks_per_seq))
+            # Wave-admission width floor for this chunk bucket: _admit_wave
+            # buckets by the wave's longest block table (rung floor 4, NOT
+            # _prefill_table's 16) — the shortest fresh prompt chunking
+            # here plus its next-token slot.
+            wave_lo = width_bucket((prev_bucket + 2 + bs - 1) // bs, self.max_blocks_per_seq)
             prev_bucket = bucket
             # Serving's _prefill_table buckets by the sequence's TOTAL block
             # count, not the chunk: a long prompt prefilled in small chunks
@@ -1357,21 +1451,38 @@ class Scheduler:
                 jnp.ones((1,), jnp.float32), key, None,
             )
             count += 1
-            # Wave-admission executable for this chunk bucket at the top
-            # batch bucket and the bucket's minimum table width — the
-            # common wave shape; other (b, s, w) keys still compile
-            # lazily, but the standard burst-arrival case is covered.
+            # Wave-admission executables for this chunk bucket: every batch
+            # rung a wave can form (≥2 admitted) × the table-width rungs
+            # wave traffic actually produces — from the shortest fresh
+            # prompt chunking here up to the longest wave-eligible prompt
+            # (prefix-hit waves pair SMALL chunk buckets with the FULL
+            # prompt's table width), clamped to the ctx budget. The round-5
+            # advisor flagged these non-default (b, s, w) keys compiling
+            # mid-traffic: only (top_bucket, s, 16-floor width) was warmed,
+            # while real waves bucket width from their block tables (rung
+            # floor 4).
             if self._supports_chunk_admit and self.draft_params is None:
-                b_b = self.sc.decode_buckets[-1]
-                self.flight.record_exec("admit", (b_b, bucket, min_w))
-                _, self.cache.k, self.cache.v = self._consume_aux(
-                    self._get_admit_jit((b_b, bucket, min_w))(
-                        self.params, self.cache.k, self.cache.v,
-                        jnp.zeros((b_b, bucket), jnp.int32), jnp.zeros((b_b,), jnp.int32),
-                        jnp.zeros((b_b,), jnp.int32), jnp.zeros((b_b, min_w), jnp.int32),
-                    )
+                wave_hi = min(
+                    max(max_w, wave_lo),
+                    width_bucket((self._wave_s_cap() + 1 + bs - 1) // bs, self.max_blocks_per_seq),
                 )
-                count += 1
+                wave_ws = sorted(
+                    w for w in set(
+                        min(r, self.max_blocks_per_seq) for r in width_rungs(wave_hi)
+                    )
+                    if wave_lo <= w <= wave_hi
+                )
+                for b_b in (b for b in self.sc.decode_buckets if b >= 2):
+                    for w in wave_ws:
+                        self.flight.record_exec("admit", (b_b, bucket, w))
+                        _, self.cache.k, self.cache.v = self._consume_aux(
+                            self._get_admit_jit((b_b, bucket, w))(
+                                self.params, self.cache.k, self.cache.v,
+                                jnp.zeros((b_b, bucket), jnp.int32), jnp.zeros((b_b,), jnp.int32),
+                                jnp.zeros((b_b,), jnp.int32), jnp.zeros((b_b, w), jnp.int32),
+                            )
+                        )
+                        count += 1
         # Mixed prefill+decode executables: the common (decode_bucket,
         # prefill_bucket) shapes — the budget-sized chunk bucket (what a
         # long prompt rides each step) at every decode bucket × width,
@@ -2126,12 +2237,30 @@ class Scheduler:
         """Enable tiered offload/onboard (KVBM G2/G3) for this scheduler."""
         self.kvbm = kvbm
 
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device-side block duplication (the COW copy). One warmed
+        executable; src/dst ride as traced scalars."""
+        self.flight.record_exec("kv_block_copy", ())
+        self.cache.k, self.cache.v = self._kv_copy_jit(
+            self.cache.k, self.cache.v, jnp.int32(src), jnp.int32(dst)
+        )
+
     def _match_prefix_tiers(self, seq: Sequence) -> List[int]:
-        """G1 match, extended through G2/G3 onboarding when KVBM is attached."""
+        """G1 match, extended through G2/G3 onboarding when KVBM is attached.
+        Onboarded blocks count as hits (reuse, not recompute) — the
+        allocator's G1 walk saw them as misses, so the counters are
+        re-attributed here; ``prefix_onboard_total`` tracks the subset that
+        crossed a tier boundary back into HBM."""
         if self.kvbm is None:
             return self.allocator.match_prefix(seq.block_hashes)
         match = self.kvbm.match_prefix(seq.block_hashes)
-        return self.kvbm.onboard(match, seq.block_hashes)
+        blocks = self.kvbm.onboard(match, seq.block_hashes)
+        onboarded = len(blocks) - len(match.g1_blocks)
+        if onboarded > 0:
+            self.prefix_onboard_total += onboarded
+            self.allocator.hit_blocks_total += onboarded
+            self.allocator.miss_blocks_total -= onboarded
+        return blocks
 
     def _block_table(self, seq: Sequence) -> jnp.ndarray:
         table = np.zeros((self.max_blocks_per_seq,), dtype=np.int32)
@@ -2321,25 +2450,36 @@ class Scheduler:
             # Host-side FSM advance: one next-state table lookup on the
             # token the step already read back — no extra device sync.
             seq.guided.advance(token)
-        # First token carries the request's queue time (arrival → admission).
+        # First token carries the request's queue time (arrival → admission)
+        # and its prefix-cache reuse (skipped prompt tokens).
         queue_s = None
+        cached = None
         if len(seq.output_ids) == 1:
             if seq.admitted_ts is not None:
                 queue_s = max(0.0, seq.admitted_ts - seq.arrival_ts)
+                self.queue_wait_s_total += queue_s
+                if seq.first_token_ts is not None:
+                    self.prefill_wait_s_total += max(0.0, seq.first_token_ts - seq.admitted_ts)
+            self.first_tokens_total += 1
+            cached = seq.cached_tokens
             self._trace_event(
                 seq, "first_token",
                 ttft_s=round(time.monotonic() - seq.arrival_ts, 6),
+                cached_tokens=seq.cached_tokens,
             )
         reason = self._check_stop(seq, token)
         if reason is not None:
             # Token that triggered 'stop' is still emitted (backend strips).
             outputs.append(
                 (seq, StepOutput(token_id=token, finished=True, finish_reason=reason,
-                                 logprob=logprob, queue_s=queue_s))
+                                 logprob=logprob, queue_s=queue_s, cached_tokens=cached))
             )
             self._finish(seq, reason, outputs, emit=False)
         else:
-            outputs.append((seq, StepOutput(token_id=token, logprob=logprob, queue_s=queue_s)))
+            outputs.append(
+                (seq, StepOutput(token_id=token, logprob=logprob, queue_s=queue_s,
+                                 cached_tokens=cached))
+            )
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:
         if seq.guided is not None and seq.guided.exhausted:
@@ -2359,11 +2499,16 @@ class Scheduler:
         return None
 
     def _register_full_blocks(self, seq: Sequence) -> None:
-        """Publish completed prompt blocks for prefix reuse."""
-        if not self.sc.enable_prefix_caching:
+        """Publish completed prompt blocks for prefix reuse. Called after
+        EVERY prefill chunk, not just at prompt completion: a burst of
+        same-prefix requests then shares KV mid-prefill — the second
+        request's first touch matches the chunks the first has already
+        computed instead of recomputing the whole prompt in parallel."""
+        if not self.sc.enable_prefix_caching or not seq.block_hashes:
             return
         bs = self.mc.block_size
-        n_full = len(seq.prompt) // bs
+        n_full = min(seq.num_computed, len(seq.prompt)) // bs
+        n_full = min(n_full, len(seq.block_hashes), len(seq.block_ids))
         if n_full > seq.num_cached_blocks:
             self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
 

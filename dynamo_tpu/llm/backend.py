@@ -129,6 +129,11 @@ class Backend(Operator):
                     # saturation signal the SLA planner needs (ref:
                     # http_queue_guard, http/service/metrics.rs).
                     yield Annotated(event="_queue", comment=str(wire["queue_s"]))
+                if isinstance(wire, dict) and wire.get("cached_tokens") is not None:
+                    # Prefix-cache reuse (first frame): the engine's count of
+                    # prompt tokens served from resident KV — the frontend
+                    # reports it as usage.prompt_tokens_details.cached_tokens.
+                    yield Annotated(event="_cached", comment=str(wire["cached_tokens"]))
                 if stopped:
                     # Upstream kept generating past a stop hit (shouldn't with
                     # prompt engines, possible with remote) — swallow.

@@ -33,9 +33,8 @@ import numpy as np
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays
 from dynamo_tpu.llm.block_manager.storage import DiskPool, HostPool
 from dynamo_tpu.llm.block_manager.transfer import (
-    gather_blocks,
     gather_blocks_async,
-    scatter_blocks,
+    scatter_blocks_device,
 )
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -181,7 +180,14 @@ class KvBlockManager:
     def onboard(self, match: TieredMatch, block_hashes: Sequence[int]) -> List[int]:
         """Copy onboardable blocks into fresh G1 blocks; returns the full
         ref-held device block list (g1 + onboarded). On allocation failure the
-        match degrades to its G1 prefix (caller prefills the rest)."""
+        match degrades to its G1 prefix (caller prefills the rest).
+
+        The device write is ASYNC: every onboarded block rides ONE stacked
+        host→device upload plus one fused scatter dispatch — no host sync —
+        so the caller's uncached-suffix prefill enqueues right behind the
+        onboard on the device stream. A warm-DRAM hit overlaps its copy-back
+        with the suffix compute instead of stalling admission on per-block
+        DMAs (the per-block scatter_blocks loop it replaces)."""
         if not match.onboardable:
             return match.g1_blocks
         try:
@@ -189,7 +195,8 @@ class KvBlockManager:
         except Exception:
             match.onboardable = []
             return match.g1_blocks
-        for bid, (h, tier) in zip(new_blocks, match.onboardable):
+        entries = []
+        for i, (h, tier) in enumerate(match.onboardable):
             if tier == CacheLevel.G2:
                 entry = self.host.get(h)
                 self.metrics.onboards_g2 += 1
@@ -200,12 +207,22 @@ class KvBlockManager:
                 entry = self.remote.get(h)
                 self.metrics.onboards_g4 += 1
             if entry is None:  # raced out of the pool — stop onboarding here
-                idx = new_blocks.index(bid)
-                self.allocator.release(new_blocks[idx:])
-                match.onboardable = match.onboardable[:idx]
-                return match.g1_blocks + new_blocks[:idx]
-            k_np, v_np = entry
-            scatter_blocks(self.cache, bid, k_np, v_np)
+                self.allocator.release(new_blocks[i:])
+                match.onboardable = match.onboardable[:i]
+                new_blocks = new_blocks[:i]
+                break
+            entries.append(entry)
+        if not new_blocks:
+            return match.g1_blocks
+        import jax.numpy as jnp
+
+        k_stack = jnp.asarray(np.stack([k for k, _ in entries], axis=1))
+        v_stack = (
+            jnp.asarray(np.stack([v for _, v in entries], axis=1))
+            if entries[0][1].size
+            else None
+        )
+        scatter_blocks_device(self.cache, new_blocks, k_stack, v_stack)
         # Register the onboarded blocks under their hashes so future requests
         # hit them in G1 directly.
         n_g1 = len(match.g1_blocks)

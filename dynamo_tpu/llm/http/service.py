@@ -92,6 +92,11 @@ class HttpService:
         )
         self._m_output_tokens = lambda model: m.counter("output_tokens_total", "output tokens", model=model)
         self._m_input_tokens = lambda model: m.counter("input_tokens_total", "input (prompt) tokens", model=model)
+        # Engine-reported prefix-cache reuse: prompt tokens served from
+        # resident KV (usage.prompt_tokens_details.cached_tokens).
+        self._m_cached_tokens = lambda model: m.counter(
+            "input_cached_tokens_total", "prompt tokens served from the prefix cache", model=model
+        )
 
     # --- lifecycle ----------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -280,6 +285,7 @@ class HttpService:
 
         async def handle():
             text_parts, n_tokens, prompt_tokens = [], 0, 0
+            cached_tokens = None
             tool_calls = None
             async for item in engine.generate(chat_body, Context()):
                 if isinstance(item, Annotated) and item.is_annotation():
@@ -288,6 +294,9 @@ class HttpService:
                         self._m_input_tokens(model).inc(prompt_tokens)
                     elif item.event == "_queue":
                         self._m_queue(model).observe(float(item.comment or 0))
+                    elif item.event == "_cached":
+                        cached_tokens = int(item.comment or 0)
+                        self._m_cached_tokens(model).inc(cached_tokens)
                     continue
                 out = _as_output(item)
                 if out is None:
@@ -298,7 +307,10 @@ class HttpService:
                     tool_calls = out.tool_calls
                 n_tokens += len(out.token_ids)
             self._m_output_tokens(model).inc(n_tokens)
-            usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
+            usage = oai.usage_dict(
+                prompt_tokens=prompt_tokens, completion_tokens=n_tokens,
+                cached_tokens=cached_tokens,
+            )
             return web.json_response(
                 oai.responses_response(rid, model, "".join(text_parts), usage, tool_calls=tool_calls)
             )
@@ -338,6 +350,7 @@ class HttpService:
         text_parts: list = []
         tool_calls = None
         n_tokens, prompt_tokens = 0, 0
+        cached_tokens = None
         status = "200"
         msg_id = f"msg-{rid}"
         msg_started = False
@@ -372,6 +385,9 @@ class HttpService:
                         self._m_input_tokens(model).inc(prompt_tokens)
                     elif item.event == "_queue":
                         self._m_queue(model).observe(float(item.comment or 0))
+                    elif item.event == "_cached":
+                        cached_tokens = int(item.comment or 0)
+                        self._m_cached_tokens(model).inc(cached_tokens)
                     continue
                 out = _as_output(item)
                 if out is None:
@@ -418,7 +434,10 @@ class HttpService:
                     {"item_id": fc["id"], "output_index": idx, "arguments": fc["arguments"]},
                 )
                 await emit("response.output_item.done", {"output_index": idx, "item": fc})
-            usage = oai.usage_dict(prompt_tokens=prompt_tokens, completion_tokens=n_tokens)
+            usage = oai.usage_dict(
+                prompt_tokens=prompt_tokens, completion_tokens=n_tokens,
+                cached_tokens=cached_tokens,
+            )
             await emit(
                 "response.completed",
                 {"response": oai.responses_envelope(rid, model, output, usage)},
@@ -511,6 +530,7 @@ class HttpService:
     async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
         bodies = self._choice_bodies(body)
         prompt_tokens_box = [0]
+        cached_tokens_box = [None]
         first_box = [None]
 
         async def run_choice(i: int, b: dict, c: Context) -> dict:
@@ -527,6 +547,9 @@ class HttpService:
                         self._m_input_tokens(model).inc(prompt_tokens_box[0])
                     elif item.event == "_queue" and i == 0:
                         self._m_queue(model).observe(float(item.comment or 0))
+                    elif item.event == "_cached" and i == 0:
+                        cached_tokens_box[0] = int(item.comment or 0)
+                        self._m_cached_tokens(model).inc(cached_tokens_box[0])
                     continue
                 out = _as_output(item)
                 if out is None:
@@ -589,7 +612,10 @@ class HttpService:
         self._m_requests(model, "200").inc()
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
-        usage = oai.usage_dict(prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens)
+        usage = oai.usage_dict(
+            prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens,
+            cached_tokens=cached_tokens_box[0],
+        )
         if kind == "chat":
             choices = [
                 oai.chat_choice(
@@ -640,6 +666,8 @@ class HttpService:
                             self._m_input_tokens(model).inc(int(item.comment or 0))
                         elif item.event == "_queue":
                             self._m_queue(model).observe(float(item.comment or 0))
+                        elif item.event == "_cached":
+                            self._m_cached_tokens(model).inc(int(item.comment or 0))
                         continue
                     await _sse_event(resp, item.event, item.comment)
                     continue
@@ -730,6 +758,8 @@ class HttpService:
                             self._m_input_tokens(model).inc(int(item.comment or 0))
                         elif item.event == "_queue" and i == 0:
                             self._m_queue(model).observe(float(item.comment or 0))
+                        elif item.event == "_cached" and i == 0:
+                            self._m_cached_tokens(model).inc(int(item.comment or 0))
                         continue
                     out = _as_output(item)
                     if out is not None:

@@ -72,6 +72,14 @@ class KvRouterConfig:
     # Gossip pending prefills between replicated routers so they don't
     # stampede one worker (ref: prefill_counter.rs).
     track_prefill_counters: bool = False
+    # In-flight prefix awareness (exact mode): routed-but-not-yet-registered
+    # prompts count as overlap on their chosen worker for this long, so a
+    # burst of same-prefix requests CONCENTRATES on one worker instead of
+    # spreading its prefix across the fleet (each spread copy prefills cold
+    # AND pollutes another worker's cache). The engine registers blocks at
+    # prompt completion and the exact index takes over well inside the TTL.
+    # 0 disables.
+    pending_overlap_ttl_s: float = 10.0
 
 
 class KvPushRouter:
@@ -95,9 +103,24 @@ class KvPushRouter:
             )
         else:
             self.indexer: KvIndexer = KvIndexer(block_size=config.block_size)
+        # Exact mode: a second, TTL'd radix tree over in-flight routing
+        # decisions (approx mode already feeds decisions into its main
+        # index). find_matches merges both, taking the max per worker.
+        self.pending_index: Optional[ApproxKvIndexer] = (
+            ApproxKvIndexer(block_size=config.block_size, ttl_s=config.pending_overlap_ttl_s)
+            if config.use_kv_events and config.pending_overlap_ttl_s > 0
+            else None
+        )
         self.prefill_counters: Optional[PrefillCountersMultiWorker] = None
         self.subscriber: Optional[KvRouterSubscriber] = None
         self._metrics_task: Optional[asyncio.Task] = None
+        # Reuse accounting: predicted overlap (scheduling time) vs the
+        # engine's ACTUAL cached_tokens report (first response frame). A
+        # persistent gap means the index is stale or the engine is evicting
+        # under pressure — the router is steering to cold workers either way.
+        self.predicted_cached_tokens_total = 0
+        self.cached_tokens_total = 0
+        self.cached_tokens_by_worker: dict = {}
 
     @classmethod
     async def create(cls, client: Client, config: Optional[KvRouterConfig] = None) -> "KvPushRouter":
@@ -145,6 +168,8 @@ class KvPushRouter:
             if w not in live_set:
                 self.sequences.remove_worker(w)
                 self.indexer.remove_worker(w)
+                if self.pending_index is not None:
+                    self.pending_index.remove_worker(w)
                 if self.prefill_counters is not None:
                     self.prefill_counters.remove_worker(w)
         for w in live:
@@ -156,6 +181,11 @@ class KvPushRouter:
         hashes = compute_block_hashes(token_ids, self.config.block_size)
         prompt_blocks = max(1, (len(token_ids) + self.config.block_size - 1) // self.config.block_size)
         overlaps = self.indexer.find_matches(hashes)
+        if self.pending_index is not None:
+            # Merge in-flight decisions: a prefix mid-prefill on a worker is
+            # (about to be) cached there even though no KV event says so yet.
+            for w, s in self.pending_index.find_matches(hashes).scores.items():
+                overlaps.scores[w] = max(overlaps.scores.get(w, 0), s)
         overrides = router_overrides or {}
         external = (
             {w: self.prefill_counters.pending_tokens(w) for w in workers}
@@ -179,11 +209,14 @@ class KvPushRouter:
         self.sequences.add_request(rid, decision.worker, len(token_ids), decision.overlap_blocks)
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision(decision.worker, token_ids)
+        elif self.pending_index is not None:
+            self.pending_index.process_routing_decision(decision.worker, token_ids)
         if self.prefill_counters is not None:
             await self.prefill_counters.new_prefill(rid, decision.worker, len(token_ids))
         logger.debug(
             "kv-routed %s -> %x (overlap=%d blocks, cost=%.1f)", rid, decision.worker, decision.overlap_blocks, decision.cost
         )
+        self.predicted_cached_tokens_total += decision.overlap_blocks * self.config.block_size
         first = True
         try:
             async for item in self.push.generate(request, ctx, instance_id=decision.worker):
@@ -192,6 +225,16 @@ class KvPushRouter:
                     if self.prefill_counters is not None:
                         await self.prefill_counters.complete_prefill(rid, decision.worker)
                     first = False
+                    # Engine-reported reuse (first frame): close the loop on
+                    # the predicted overlap so the router's accounting
+                    # reflects blocks actually skipped, not hoped for.
+                    data = item.data if isinstance(item, Annotated) else item
+                    if isinstance(data, dict) and data.get("cached_tokens") is not None:
+                        n = int(data["cached_tokens"])
+                        self.cached_tokens_total += n
+                        self.cached_tokens_by_worker[decision.worker] = (
+                            self.cached_tokens_by_worker.get(decision.worker, 0) + n
+                        )
                 yield item
         finally:
             self.sequences.free(rid)
@@ -199,6 +242,15 @@ class KvPushRouter:
                 # Stream ended before the first token (abort/error): retract
                 # the pending-prefill gossip too.
                 await self.prefill_counters.complete_prefill(rid, decision.worker)
+
+    def stats(self) -> dict:
+        """Router-side reuse accounting: predicted (index overlap at
+        scheduling time) vs actual (engine-reported cached_tokens)."""
+        return {
+            "predicted_cached_tokens_total": self.predicted_cached_tokens_total,
+            "cached_tokens_total": self.cached_tokens_total,
+            "cached_tokens_by_worker": dict(self.cached_tokens_by_worker),
+        }
 
     async def close(self) -> None:
         if self.subscriber is not None:

@@ -52,10 +52,18 @@ class ActiveSequencesMultiWorker:
         overlap_blocks: int,
     ) -> None:
         """Register a scheduled request: prefill need = tokens beyond the
-        worker's cached prefix; decode load = total sequence blocks."""
+        worker's cached prefix; decode load = the NEW blocks this request
+        adds. Overlapped blocks are shared with the resident prefix — they
+        cost the worker no extra HBM and no extra write bandwidth, so
+        counting them at full weight made the cost model route high-overlap
+        requests AWAY from their warm worker the moment it had one request
+        in flight (the engine's prefix-cache hit then never happened —
+        measured as the 1.1× router-benefit plateau in
+        tools/bench_router_prefix.py)."""
         self.ensure_worker(worker)
         prefill = max(0, prompt_tokens - overlap_blocks * self.block_size)
         blocks = (prompt_tokens + self.block_size - 1) // self.block_size
+        blocks = max(0, blocks - overlap_blocks)
         seq = _ActiveSeq(worker=worker, prefill_tokens=prefill, decode_blocks=blocks)
         self._seqs[request_id] = seq
         self._prefill_tokens[worker] += prefill

@@ -212,22 +212,34 @@ class MockTpuEngine:
             self._reap_stopped()
             step_ms = 0.0
 
-            # Admission: one prefill chunk per step (the real scheduler's
-            # decode-first/one-admission policy), bounded by max_batch.
-            # Prefer a mid-chunk sequence (it already holds blocks — leaving
-            # it parked while the head can't allocate is a head-of-line
+            # Admission: a WAVE of prefill chunks per step, bounded by a
+            # max_prefill_chunk token budget — mirroring the real
+            # scheduler's wave admission + mixed-step prefill budget (a
+            # burst of short/cache-hit prompts admits together instead of
+            # serializing one per step, which queued concentrated KV-routed
+            # traffic behind an artificial one-admission rule). Prefer
+            # mid-chunk sequences (they already hold blocks — leaving one
+            # parked while the head can't allocate is a head-of-line
             # deadlock); otherwise take the head.
-            if self.waiting and len(self.running) < args.max_batch:
+            wave_tokens = 0
+            while (
+                self.waiting
+                and len(self.running) < args.max_batch
+                and wave_tokens < args.max_prefill_chunk
+            ):
                 seq = next((s for s in self.waiting if s.block_ids), self.waiting[0])
-                chunk = self._admit_chunk(seq)
-                if chunk:
-                    step_ms += args.prefill_ms(chunk)
-                    self.prefill_tokens_done += chunk
+                chunk = self._admit_chunk(seq, args.max_prefill_chunk - wave_tokens)
+                wave_tokens += chunk
+                self.prefill_tokens_done += chunk
                 if seq.in_decode:
                     # remove() not pop(0): _admit_chunk's allocation may have
                     # preempted a victim INTO waiting[0] just now.
                     self.waiting.remove(seq)
                     self.running.append(seq)
+                else:
+                    break  # blocked on KV blocks, or budget consumed mid-prompt
+            if wave_tokens:
+                step_ms += args.prefill_ms(wave_tokens)
 
             # Batched decode step: every running sequence produces one token;
             # latency depends on batch width and total active KV.
@@ -267,7 +279,14 @@ class MockTpuEngine:
                 else:
                     token = s.tokens[s.generated % len(s.tokens)] if s.tokens else s.generated
                     finish = "length" if s.generated >= s.max_tokens else None
-                s.out.put_nowait({"token_ids": [token], "finish_reason": finish, "index": 0})
+                frame = {"token_ids": [token], "finish_reason": finish, "index": 0}
+                if s.generated == 1:
+                    # First frame carries the real engine's reuse report:
+                    # prompt tokens whose simulated prefill was skipped by
+                    # the prefix cache (the wire shape router/frontend
+                    # accounting reads).
+                    frame["cached_tokens"] = s.cached_tokens
+                s.out.put_nowait(frame)
                 if finish:
                     self._finish(s)
             if not (self.waiting or self.running):
@@ -296,9 +315,13 @@ class MockTpuEngine:
                 if not s.done:
                     s.out.put_nowait({"token_ids": [], "finish_reason": "cancelled", "index": 0})
 
-    def _admit_chunk(self, seq: _Seq) -> int:
+    def _admit_chunk(self, seq: _Seq, budget: Optional[int] = None) -> int:
         """Advance one prefill chunk; returns simulated chunk tokens (0 when
-        blocked on KV blocks). First touch matches the prefix cache."""
+        blocked on KV blocks). First touch matches the prefix cache —
+        cached tokens shorten the simulated prefill (the chunk covers only
+        the uncached remainder, the real engine's skipped-FLOPs behavior).
+        ``budget`` caps the chunk (wave admission shares one per-step
+        token budget across admitted sequences)."""
         args = self.args
         bs = args.block_size
         if seq.computed == 0 and not seq.block_ids:
@@ -312,8 +335,13 @@ class MockTpuEngine:
             seq.computed = min(seq.cached_tokens, seq.prefill_span)
             # Cover the full current length (prompt + any generated tokens
             # being recomputed after preemption) plus the next write slot.
+            # Admission never preempts — it backpressures (the real
+            # scheduler's _admit policy): preempting a decode to admit a
+            # newcomer just trades one recompute for another, and under
+            # wave admission it livelocks (victims re-match their own
+            # still-registered prefix and thrash).
             needed = (seq.total_len + 1 + bs - 1) // bs - len(seq.block_ids)
-            if needed > 0 and not self._allocate(seq, needed):
+            if needed > 0 and not self._allocate(seq, needed, preempt=False):
                 # Roll back the first touch entirely; retried next step.
                 self.allocator.release(seq.block_ids)
                 seq.block_ids = []
@@ -326,15 +354,22 @@ class MockTpuEngine:
             self.cached_tokens_total += seq.cached_tokens
         remaining = seq.prefill_span - seq.computed
         chunk = min(remaining, args.max_prefill_chunk)
+        if budget is not None:
+            chunk = min(chunk, budget)
         seq.computed += chunk
-        if seq.in_decode:
-            n_full = len(seq.hashes)
-            self.allocator.register_hashes(seq.block_ids[:n_full], seq.hashes)
+        # Register every completed block as chunks land (the real
+        # scheduler's per-chunk registration): concurrent same-prefix
+        # requests share KV mid-prefill.
+        n_done = min(seq.computed, len(seq.tokens)) // bs
+        n_done = min(n_done, len(seq.hashes), len(seq.block_ids))
+        if n_done:
+            self.allocator.register_hashes(seq.block_ids[:n_done], seq.hashes[:n_done])
         return chunk
 
-    def _allocate(self, seq: _Seq, n: int) -> bool:
+    def _allocate(self, seq: _Seq, n: int, preempt: bool = True) -> bool:
         """Allocate n blocks, preempting the newest running sequence when the
-        pool dips below the watermark (ref mocker's eviction policy)."""
+        pool dips below the watermark (ref mocker's eviction policy).
+        ``preempt=False`` (admission path) backpressures instead."""
         args = self.args
         floor = int(args.num_blocks * args.watermark)
         while True:
@@ -344,7 +379,7 @@ class MockTpuEngine:
                     return True
                 except OutOfBlocksError:
                     pass
-            if not self._preempt_newest(exclude=seq):
+            if not preempt or not self._preempt_newest(exclude=seq):
                 return False
 
     def _grow_blocks(self, seq: _Seq) -> bool:
@@ -394,8 +429,22 @@ class MockTpuEngine:
             kv_active_blocks=self.allocator.num_active,
             prefill_tokens_in_flight=sum(len(s.tokens) - s.computed for s in self.waiting),
             request_total=self.request_total,
+            cached_tokens_total=self.cached_tokens_total,
+            prefix_hit_blocks_total=self.allocator.hit_blocks_total,
+            prefix_miss_blocks_total=self.allocator.miss_blocks_total,
+            prefix_evicted_blocks_total=self.allocator.evicted_blocks_total,
         )
 
     def stats_handler(self) -> dict:
         m = self.metrics()
-        return {"kv_usage": m.kv_usage, "num_running": m.num_running, "num_waiting": m.num_waiting}
+        return {
+            "kv_usage": m.kv_usage,
+            "num_running": m.num_running,
+            "num_waiting": m.num_waiting,
+            # Prefix-cache hit accounting over the scrape path, same keys as
+            # the real engine's stats_handler (aggregator counters).
+            "cached_tokens_total": m.cached_tokens_total,
+            "prefix_hit_blocks_total": m.prefix_hit_blocks_total,
+            "prefix_miss_blocks_total": m.prefix_miss_blocks_total,
+            "prefix_evicted_blocks_total": m.prefix_evicted_blocks_total,
+        }

@@ -397,12 +397,20 @@ def completion_response(
     )
 
 
-def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
-    return {
+def usage_dict(
+    prompt_tokens: int, completion_tokens: int, cached_tokens: Optional[int] = None
+) -> dict:
+    """OpenAI usage block. ``cached_tokens`` (engine-reported prefix-cache
+    reuse) renders as ``prompt_tokens_details.cached_tokens`` when known —
+    the OpenAI prompt-caching wire shape."""
+    out = {
         "prompt_tokens": prompt_tokens,
         "completion_tokens": completion_tokens,
         "total_tokens": prompt_tokens + completion_tokens,
     }
+    if cached_tokens is not None:
+        out["prompt_tokens_details"] = {"cached_tokens": int(cached_tokens)}
+    return out
 
 
 def error_body(message: str, err_type: str = "invalid_request_error", code: int = 400) -> dict:

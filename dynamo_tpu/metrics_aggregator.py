@@ -36,6 +36,10 @@ COUNTER_KEYS = (
     "moe_dropped_total", "moe_assignments_total",
     "mixed_steps_total", "mixed_prefill_tokens_total", "mixed_decode_tokens_total",
     "overlap_steps_total", "overlap_flushes_total",
+    "cached_tokens_total",
+    "prefix_hit_blocks_total", "prefix_miss_blocks_total",
+    "prefix_evicted_blocks_total", "prefix_onboard_total",
+    "queue_wait_seconds_total", "prefill_wait_seconds_total", "first_tokens_total",
     "decode_host_gap_events_total", "decode_host_gap_seconds_total",
     "compiles_total", "compiles_after_warmup_total",
     "guided_requests_total", "guided_grammar_compiles_total",

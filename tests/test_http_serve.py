@@ -260,3 +260,41 @@ async def test_queue_time_metric_exported():
     finally:
         await service.stop()
         await engine.stop()
+
+
+async def test_cached_tokens_in_usage_details():
+    """Engine-reported prefix-cache reuse surfaces as OpenAI
+    usage.prompt_tokens_details.cached_tokens (and the frontend's
+    input_cached_tokens counter): second identical prompt hits."""
+    service, engine = await make_local_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": MODEL,
+                # Long enough to span several 16-token KV blocks.
+                "messages": [{"role": "user", "content": "cached tokens probe " * 8}],
+                "max_tokens": 4,
+                "temperature": 0,
+            }
+            url = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+            async with s.post(url, json=body) as r:
+                cold = await r.json()
+            async with s.post(url, json=body) as r:
+                warm = await r.json()
+            assert cold["usage"]["prompt_tokens_details"]["cached_tokens"] == 0
+            warm_cached = warm["usage"]["prompt_tokens_details"]["cached_tokens"]
+            assert warm_cached > 0
+            # Identical prompts → full cover: everything but the one
+            # recomputed logits token is served from cache.
+            assert warm_cached >= warm["usage"]["prompt_tokens"] - 16
+            async with s.get(f"http://127.0.0.1:{service.port}/metrics") as r:
+                text = await r.text()
+        for line in text.splitlines():
+            if line.startswith("dynamo_frontend_input_cached_tokens_total"):
+                assert float(line.split()[-1]) == warm_cached
+                break
+        else:
+            raise AssertionError("input_cached_tokens_total not exported")
+    finally:
+        await service.stop()
+        await engine.stop()

@@ -109,10 +109,12 @@ def test_active_sequences_lifecycle():
     seqs = ActiveSequencesMultiWorker(block_size=BS)
     seqs.add_request("r1", 7, prompt_tokens=64, overlap_blocks=2)
     assert seqs.prefill_tokens(7) == 32  # 64 - 2*16 cached
-    assert seqs.decode_blocks(7) == 4
+    # Decode load counts only the NEW blocks (4 total - 2 shared with the
+    # resident prefix): overlapped blocks cost the worker nothing extra.
+    assert seqs.decode_blocks(7) == 2
     seqs.mark_prefill_done("r1")
     assert seqs.prefill_tokens(7) == 0
-    assert seqs.decode_blocks(7) == 4
+    assert seqs.decode_blocks(7) == 2
     assert seqs.free("r1") == 7
     assert seqs.decode_blocks(7) == 0
 

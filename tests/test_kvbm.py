@@ -161,7 +161,11 @@ async def test_engine_determinism_across_offload_cycles():
     engine = build(host_blocks=32)
     try:
         prompt_a = list(range(10, 58))  # 3 blocks
-        prompt_b = list(range(100, 148))
+        # B is wider than the free pool, so serving it must evict A's
+        # cached blocks. (A 48-token B no longer forces eviction: the
+        # full-cover copy-on-write hit made re-serves cheaper — they reuse
+        # every resident block instead of re-prefilling the last one.)
+        prompt_b = list(range(100, 180))  # 5 full blocks + growth
         first = await run(engine, prompt_a)
         # Push A out of device cache by running B (device pool is tiny).
         for _ in range(3):
@@ -205,13 +209,26 @@ async def test_g4_remote_tier_cross_worker():
                 alloc_a.register_hashes(blocks, hashes)
                 alloc_a.release(blocks)
                 # Evicting all 4 cascades: host holds 1, disk holds 1, the
-                # rest spill to G4 (remote).
-                alloc_a.allocate(4)
+                # rest spill to G4 (remote). Eviction consumes the chain
+                # TAIL-first (the graceful-degradation LRU order), so the
+                # head blocks land in A's local tiers.
+                got = alloc_a.allocate(4)
+                kvbm_a.flush_pending()
+                alloc_a.release(got)
+                # Two more eviction rounds push the chain HEAD through
+                # host→disk→remote as well — worker B can only see the
+                # shared G4 pool, and a cross-worker match must walk the
+                # chain from its head.
+                churn = compute_block_hashes(list(range(5000, 5032)), 16)
+                cblocks = alloc_a.allocate(2)
+                alloc_a.register_hashes(cblocks, churn)
+                alloc_a.release(cblocks)
+                alloc_a.allocate(4)  # drains the free list AND evicts both
                 kvbm_a.flush_pending()
                 return contents
 
             contents = await asyncio.to_thread(worker_a_evicts)
-            assert kvbm_a.metrics.offloads_g2 == 4
+            assert kvbm_a.metrics.offloads_g2 == 6  # 4 chain + 2 churn
             assert kvbm_a.metrics.offloads_g3 >= 1
             assert kvbm_a.metrics.offloads_g4 >= 1
             await asyncio.sleep(0.05)  # fire-and-forget puts land

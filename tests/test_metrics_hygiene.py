@@ -163,6 +163,58 @@ def test_overlap_decode_metrics_render_in_all_roles():
         )
 
 
+def test_prefix_cache_metrics_render_in_all_roles():
+    """Automatic prefix caching's counters must flow engine/mocker stats →
+    aggregator → Prometheus: keys declared in COUNTER_KEYS, present on the
+    ForwardPassMetrics wire and the mocker's scrape dict, and rendered as
+    rate()-able counters."""
+    from dynamo_tpu.engine.kv_cache import BlockAllocator
+    from dynamo_tpu.engine.scheduler import ForwardPassMetrics
+    from dynamo_tpu.llm.mocker import MockTpuEngine
+    from dynamo_tpu.llm.tokens import compute_block_hashes
+
+    new_keys = (
+        "cached_tokens_total", "prefix_hit_blocks_total",
+        "prefix_miss_blocks_total", "prefix_evicted_blocks_total",
+        "prefix_onboard_total",
+    )
+    for key in new_keys:
+        assert key in COUNTER_KEYS, f"{key} missing from aggregator COUNTER_KEYS"
+
+    # Wire shape: the scheduler's metrics snapshot carries every key.
+    wire = ForwardPassMetrics().to_wire()
+    for key in new_keys:
+        assert key in wire, f"{key} missing from ForwardPassMetrics wire"
+
+    # Allocator ground truth: hit/miss/evict counters move with the cache.
+    alloc = BlockAllocator(4)
+    tokens = list(range(32))
+    hashes = compute_block_hashes(tokens, 16)
+    blocks = alloc.allocate(2)
+    alloc.register_hashes(blocks, hashes)
+    alloc.release(blocks)
+    assert alloc.match_prefix(hashes) == blocks  # hit both
+    alloc.release(blocks)
+    assert alloc.match_prefix([123456789]) == []  # miss
+    assert alloc.hit_blocks_total == 2 and alloc.miss_blocks_total == 1
+    alloc.allocate(4)  # forces eviction of the two cached blocks
+    assert alloc.evicted_blocks_total == 2
+
+    # Mocker scrape dict exposes the same keys as the real engine's
+    # stats_handler (router e2e fleets scrape real hit accounting).
+    stats = MockTpuEngine().stats_handler()
+    for key in ("cached_tokens_total", "prefix_hit_blocks_total",
+                "prefix_miss_blocks_total", "prefix_evicted_blocks_total"):
+        assert key in stats, f"{key} missing from mocker stats_handler"
+
+    # Aggregator renders them as Counter families (rate()-able).
+    fams = parse_families(aggregator_registry().render().decode())
+    for key in new_keys:
+        assert fams.get(f"dynamo_component_worker_{key}", {}).get("type") == "counter", (
+            f"{key} not rendered as a counter by the aggregator"
+        )
+
+
 def test_get_or_create_rejects_label_mismatch_on_reuse():
     """Regression: sibling registries reusing a collector with a DIFFERENT
     label set must get a clear error at declaration time, not a confusing

@@ -1,0 +1,261 @@
+"""Automatic prefix caching: engine-level KV block reuse.
+
+Covers the acceptance bar for the prefix-cache tentpole: cached-vs-cold
+parity (token streams AND KV block contents bit-identical), copy-on-write
+divergence (a full-cover hit must not write into a block another live
+sequence references), LRU eviction under memory pressure with in-use blocks
+pinned, the DRAM (KVBM G2) onboard hit path, and the
+0-post-warmup-XLA-compiles invariant with prefix caching enabled.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import get_config
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+from dynamo_tpu.llm.block_manager import KvBlockManager
+from dynamo_tpu.llm.block_manager.transfer import gather_blocks
+
+CFG = get_config("tiny")
+BS = CFG.block_size
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(3), dtype=jnp.float32)
+
+
+def make_sched(num_blocks=256, caching=True, **kw):
+    sc = SchedulerConfig(
+        num_blocks=num_blocks,
+        prefill_buckets=[32, 64, 128],
+        decode_buckets=[1, 2, 4],
+        enable_prefix_caching=caching,
+        num_scheduler_steps=1,
+        **kw,
+    )
+    return Scheduler(CFG, PARAMS, sc, dtype=jnp.float32)
+
+
+def run_one(sched, rid, prompt, max_tokens=6):
+    """Serve one request to completion; returns (tokens, cached_tokens,
+    prompt block ids snapshotted at first token)."""
+    sched.add_request(rid, prompt, SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=max_tokens, ignore_eos=True))
+    tokens, cached, block_ids = [], None, None
+    for _ in range(400):
+        for s, o in sched.step():
+            if s.request_id != rid:
+                continue
+            if o.cached_tokens is not None:
+                cached = o.cached_tokens
+                block_ids = list(s.block_ids)
+            if o.token_id >= 0:
+                tokens.append(o.token_id)
+        if not sched.has_work():
+            break
+    assert not sched.has_work()
+    return tokens, cached, block_ids
+
+
+def prompt_kv(sched, block_ids, n_tokens):
+    """Host copy of the KV rows covering the first n_tokens behind the
+    given block table."""
+    rows_k, rows_v = [], []
+    for bid in block_ids[: (n_tokens + BS - 1) // BS]:
+        k, v = gather_blocks(sched.cache, bid)
+        rows_k.append(k)
+        rows_v.append(v)
+    k = np.concatenate(rows_k, axis=1)[:, :n_tokens]
+    v = np.concatenate(rows_v, axis=1)[:, :n_tokens]
+    return k, v
+
+
+def test_cached_vs_cold_parity_tokens_and_kv():
+    """A full-prefix hit must produce bit-identical outputs AND KV to a
+    cold run: reuse skips compute, never changes results."""
+    prompt = list(range(1, 97))  # 96 = 6 full blocks → full-cover hit
+    cold = make_sched(caching=False)
+    t_cold, _, b_cold = run_one(cold, "cold", prompt)
+    kv_cold = prompt_kv(cold, b_cold, len(prompt))
+
+    sched = make_sched()
+    t1, c1, b1 = run_one(sched, "r1", prompt)
+    t2, c2, b2 = run_one(sched, "r2", prompt)
+    assert t1 == t_cold and t2 == t_cold
+    assert c1 == 0
+    # Full cover: every prompt token but the recomputed last one is served
+    # from cache.
+    assert c2 == len(prompt) - 1
+    kv_hit = prompt_kv(sched, b2, len(prompt))
+    # Cached rows are the SAME buffers the cold path wrote — bit-identical.
+    np.testing.assert_array_equal(kv_hit[0][:, :-1], kv_cold[0][:, :-1])
+    np.testing.assert_array_equal(kv_hit[1][:, :-1], kv_cold[1][:, :-1])
+    # The one recomputed row (logits producer) runs in a different-bucket
+    # executable — numerically equal up to f32 reduction order.
+    np.testing.assert_allclose(kv_hit[0][:, -1], kv_cold[0][:, -1], atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(kv_hit[1][:, -1], kv_cold[1][:, -1], atol=1e-5, rtol=1e-4)
+
+
+def test_partial_prefix_hit_prefills_only_suffix():
+    shared = list(range(1, 81))  # 5 full blocks
+    sched = make_sched()
+    t1, _, _ = run_one(sched, "a", shared + list(range(500, 532)))
+    t2, c2, _ = run_one(sched, "b", shared + list(range(700, 732)))
+    assert c2 == (len(shared) // BS) * BS  # 80 tokens skipped
+    # Parity with an uncached run of the same prompt.
+    cold = make_sched(caching=False)
+    t2_cold, _, _ = run_one(cold, "b", shared + list(range(700, 732)))
+    assert t2 == t2_cold
+
+
+def test_copy_on_write_divergence():
+    """A full-cover hit whose final matched block another RUNNING sequence
+    still references must copy-on-write: the holder's block is untouched,
+    both sequences produce reference outputs."""
+    prompt = list(range(1, 97))
+    # Reference streams, computed on isolated schedulers.
+    ref = make_sched(caching=False)
+    a_ref, _, _ = run_one(ref, "a", prompt, max_tokens=20)
+    b_ref = run_one(make_sched(caching=False), "b", prompt, max_tokens=4)[0]
+
+    sched = make_sched(enable_mixed_batching=False)
+    sched.add_request("a", prompt, SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=20, ignore_eos=True))
+    got = {"a": [], "b": []}
+    a_blocks = None
+    # Run A through prefill + a few decode steps so it HOLDS its blocks.
+    for _ in range(6):
+        for s, o in sched.step():
+            if o.token_id >= 0:
+                got[s.request_id].append(o.token_id)
+    a_blocks = list(sched.by_id["a"].block_ids)
+    a_last_kv = gather_blocks(sched.cache, a_blocks[5])
+    # B arrives with the SAME prompt while A runs: full-cover match, last
+    # block shared with a live holder → COW.
+    sched.add_request("b", prompt, SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=4, ignore_eos=True))
+    b_blocks = None
+    for _ in range(400):
+        for s, o in sched.step():
+            if o.token_id >= 0:
+                got[s.request_id].append(o.token_id)
+            if s.request_id == "b" and b_blocks is None and o.cached_tokens is not None:
+                b_blocks = list(s.block_ids)
+        if not sched.has_work():
+            break
+    assert not sched.has_work()
+    assert sched.cow_blocks_total == 1
+    # Shared prefix blocks identical, final prompt block diverged (private
+    # copy), and A's original block content is untouched.
+    assert b_blocks[:5] == a_blocks[:5]
+    assert b_blocks[5] != a_blocks[5]
+    after = gather_blocks(sched.cache, a_blocks[5])
+    np.testing.assert_array_equal(after[0], a_last_kv[0])
+    np.testing.assert_array_equal(after[1], a_last_kv[1])
+    assert got["a"] == a_ref
+    assert got["b"] == b_ref
+
+
+def test_eviction_under_pressure_pins_in_use_blocks():
+    """Cache churn under a tight pool evicts only refcount-0 cached blocks;
+    a running sequence's blocks are pinned and its output is unaffected."""
+    ref = make_sched(num_blocks=256)
+    long_ref, _, _ = run_one(ref, "long", list(range(1, 49)), max_tokens=60)
+
+    sched = make_sched(num_blocks=20)  # 19 usable
+    sched.add_request("long", list(range(1, 49)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=60, ignore_eos=True))
+    got: dict = {}
+    filler = 0
+    for i in range(900):
+        # Keep injecting distinct prompts so the pool churns: each registers
+        # blocks that must be evicted to admit the next.
+        if i % 3 == 0 and len(sched.waiting) < 2 and sched.by_id.get("long") is not None:
+            filler += 1
+            sched.add_request(f"f{filler}", list(range(100 * filler, 100 * filler + 33)),
+                              SamplingParams(temperature=0.0),
+                              StopConditions(max_tokens=2, ignore_eos=True))
+        for s, o in sched.step():
+            if o.token_id >= 0:
+                got.setdefault(s.request_id, []).append(o.token_id)
+        if "long" not in sched.by_id and not sched.has_work():
+            break
+    assert got["long"] == long_ref
+    assert sched.allocator.evicted_blocks_total > 0
+    # Pool bookkeeping intact after the churn: nothing double-freed.
+    sched_ids = set(sched.allocator._free) | set(sched.allocator._cached_lru)
+    assert len(sched.allocator._free) == len(set(sched.allocator._free))
+    assert len(sched_ids) <= sched.allocator.num_blocks
+
+
+def test_dram_onboard_hit_path():
+    """Blocks evicted HBM→DRAM (KVBM G2) stay indexed: a later request
+    onboards them back and still skips prefill, with parity."""
+    sched = make_sched(num_blocks=16)  # 15 usable — tight
+    kvbm = KvBlockManager(sched.cache, sched.allocator, host_blocks=32)
+    sched.attach_kvbm(kvbm)
+
+    prompt = list(range(1, 81)) + list(range(900, 916))  # 6 blocks + slack
+    t1, c1, _ = run_one(sched, "p1", prompt, max_tokens=2)
+    assert c1 == 0
+    # Churn the pool so p1's cached blocks evict → offload to the host tier.
+    for i in range(3):
+        run_one(sched, f"f{i}", list(range(200 * (i + 1), 200 * (i + 1) + 81)), max_tokens=2)
+    kvbm.flush_pending()
+    assert kvbm.metrics.offloads_g2 > 0
+
+    t2, c2, _ = run_one(sched, "p2", prompt, max_tokens=2)
+    assert t2 == t1
+    assert c2 and c2 > 0, "onboarded blocks must count as cached tokens"
+    assert sched.prefix_onboard_total > 0
+    assert kvbm.metrics.onboards_g2 > 0
+    m = sched.metrics()
+    assert m.prefix_onboard_total == sched.prefix_onboard_total
+
+
+def test_zero_postwarmup_compiles_with_prefix_caching():
+    """Warmup must cover the prefix-cache serving set: cold prefill,
+    full-cover hit (COW block copy), partial hit continuation, and decode —
+    all with 0 XLA compiles after warmup (flight-recorder-verified)."""
+    sched = make_sched(enable_mixed_batching=False)
+    sched.warmup(160)
+    sched.flight.mark_warmup_done(warmed=True)
+
+    prompt = list(range(1, 97))
+    run_one(sched, "cold", prompt, max_tokens=4)
+    # Full-cover in-place hit (sole owner).
+    run_one(sched, "hit", prompt, max_tokens=4)
+    # COW path: B full-covers while A holds the last block.
+    sched.add_request("a", prompt, SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=16, ignore_eos=True))
+    for _ in range(4):
+        sched.step()
+    sched.add_request("b", prompt, SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=4, ignore_eos=True))
+    for _ in range(400):
+        sched.step()
+        if not sched.has_work():
+            break
+    # Partial-prefix continuation.
+    run_one(sched, "part", prompt[:80] + list(range(600, 632)), max_tokens=4)
+    assert sched.cow_blocks_total >= 1
+    assert sched.flight.compiles_after_warmup_total == 0, (
+        sched.flight.post_warmup_keys
+    )
+
+
+def test_cached_tokens_accounting_matches_allocator():
+    """StepOutput.cached_tokens must equal the blocks the allocator served
+    from cache (full-cover: n·bs − 1)."""
+    sched = make_sched()
+    prompt = list(range(1, 97))
+    run_one(sched, "a", prompt)
+    h0 = sched.allocator.hit_blocks_total
+    _, cached, _ = run_one(sched, "b", prompt)
+    matched = sched.allocator.hit_blocks_total - h0
+    assert cached == matched * BS - 1  # full cover recomputes one token
+    h0 = sched.allocator.hit_blocks_total
+    _, cached, _ = run_one(sched, "c", prompt[:80] + list(range(700, 717)))
+    matched = sched.allocator.hit_blocks_total - h0
+    assert cached == matched * BS
+    assert sched.metrics().cached_tokens_total == sched.cached_tokens_total

@@ -283,3 +283,51 @@ async def test_soak_churn_8_mockers_kill_join_under_load():
             await pub.stop()
             await mpub.stop()
         await drt.shutdown()
+
+
+async def test_cached_tokens_accounting_over_wire():
+    """Prefix-cache hit accounting must flow engine→router over the real
+    wire path: the mocker reports cached_tokens on its first frame, the
+    router folds it into per-worker reuse accounting, and the totals match
+    the workers' own counters."""
+    drt = await DistributedRuntime.detached()
+    cleanup = []
+    try:
+        ep = drt.namespace("kvcached").component("mocker").endpoint("generate")
+        for _ in range(2):
+            cleanup.append(await spawn_mocker(drt, ep))
+        client = await ep.client()
+        await client.wait_for_instances(2, timeout=5)
+        router = await KvPushRouter.create(client, KvRouterConfig(block_size=16))
+
+        prefix = list(range(64))  # 4 blocks
+
+        async def run_one(tokens):
+            async for item in router.generate(req(tokens), Context()):
+                pass
+
+        # Cold establishment, then same-prefix follow-ups that must hit.
+        await run_one(prefix + [900, 901])
+        await asyncio.sleep(0.2)  # KV events → indexer
+        for i in range(4):
+            await run_one(prefix + [1000 + i, 2000 + i])
+
+        stats = router.stats()
+        # 4 follow-ups × 4 shared blocks × 16 tokens.
+        assert stats["cached_tokens_total"] == 4 * 4 * 16, stats
+        assert stats["cached_tokens_total"] == sum(
+            c[0].cached_tokens_total for c in cleanup
+        )
+        assert sum(stats["cached_tokens_by_worker"].values()) == stats["cached_tokens_total"]
+        # Predicted overlap (index) is closed-loop with the engine's report.
+        assert stats["predicted_cached_tokens_total"] >= stats["cached_tokens_total"]
+        # The scrape path exposes the same accounting keys.
+        wire_stats = [c[0].stats_handler() for c in cleanup]
+        assert sum(s["cached_tokens_total"] for s in wire_stats) == stats["cached_tokens_total"]
+        assert sum(s["prefix_hit_blocks_total"] for s in wire_stats) >= 16
+        await router.close()
+    finally:
+        for engine, handle, pub, mpub in cleanup:
+            await pub.stop()
+            await mpub.stop()
+        await drt.shutdown()
