@@ -94,6 +94,16 @@ class TpuEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
         self._kv_event_sink = kv_event_sink
+        # Stall watchdog: work queued but no step completing for
+        # stall_after_s marks the engine stalled (counter + log + unhealthy
+        # /health). Evaluated lazily on every stats scrape / health probe —
+        # no background task, deterministic under a monkeypatched clock.
+        from dynamo_tpu.runtime.telemetry import StallWatchdog
+
+        self.watchdog = StallWatchdog(
+            probe=lambda: (scheduler.has_work(), scheduler.flight.last_step_ts),
+            stall_after_s=scheduler.sc.stall_after_s,
+        )
 
     # --- construction -------------------------------------------------------
     @classmethod
@@ -432,11 +442,27 @@ class TpuEngine:
         # tracker (compiles_after_warmup_total > 0 in steady state is the
         # alert that shapes are compiling mid-traffic — PR 1's silent killer).
         stats.update(self.scheduler.flight.to_stats())
+        # KV-pool utilization gauges (free/cached depth, fragmentation,
+        # prefix hit rate) + the SLO/goodput account + stall-watchdog state.
+        stats.update(self.scheduler.kv_gauges())
+        stats.update(self.scheduler.slo.to_stats())
+        stats.update(self.watchdog.to_stats())
+        # Mergeable latency digests (ttft/tpot/itl/queue_wait + per-phase
+        # step durations): the aggregator merges these across workers into
+        # true fleet-wide quantiles — averaging per-worker p99s does not.
+        stats["digests"] = self.scheduler.telemetry.to_wire()
         # Guided decoding: request + grammar-compile counters (scrape-
         # visible so dashboards can watch structured-output traffic).
         if self.scheduler.guided is not None:
             stats.update(self.scheduler.guided.stats())
         return stats
+
+    def debug_state(self) -> dict:
+        """Live engine introspection for the health server's /debug/state."""
+        state = self.scheduler.debug_state()
+        state["watchdog"] = self.watchdog.to_stats()
+        state["watchdog"]["stall_after_s"] = self.watchdog.stall_after_s
+        return state
 
     def attach_guided_tokenizer(self, tokenizer) -> None:
         """Enable guided decoding post-build (pipeline assembly attaches the

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import bisect
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from dynamo_tpu.runtime.logging import get_logger
@@ -83,11 +84,120 @@ class _PhaseHist:
         return self.buckets[-1]
 
 
+# Peak hardware numbers for the live MFU / HBM-roofline gauges, keyed by a
+# lowercase substring of jax's device_kind. Sources: published TPU specs
+# (bf16 FLOPs, HBM bandwidth). CPU gets a nominal floor so the gauges stay
+# defined (their absolute value is meaningless off-accelerator; the bench
+# anchors are the real numbers).
+_PEAKS: Tuple[Tuple[str, float, float], ...] = (
+    ("v5e", 197e12, 819e9),
+    ("v5p", 459e12, 2765e9),
+    ("v5", 197e12, 819e9),
+    ("v4", 275e12, 1228e9),
+    ("v6", 918e12, 1640e9),
+)
+_CPU_PEAKS = (1e12, 100e9)
+
+
+def detect_peaks() -> Tuple[float, float]:
+    """(peak FLOPs/s, peak HBM bytes/s) for the local accelerator."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — no backend is a valid state
+        return _CPU_PEAKS
+    for sub, flops, bw in _PEAKS:
+        if sub in kind:
+            return flops, bw
+    return _CPU_PEAKS
+
+
+class StepCostModel:
+    """Per-step FLOPs + bytes model so BENCH roofline numbers become a live
+    metric. Analytical, host-side only:
+
+    - FLOPs ≈ 2 · params · tokens (the matmul-dominated transformer count;
+      attention FLOPs are second-order at serving context lengths).
+    - Bytes: decode/mixed steps stream the whole parameter set once per
+      dispatch plus the active KV they gather; prefill writes its chunk's
+      KV and re-reads the prefix.
+
+    ``param_count``/``param_bytes`` come from the actual params pytree and
+    ``kv_bytes_per_token`` from the actual cache arrays, so quantized
+    deployments (int8 weights/KV) are modeled at their real byte widths.
+    """
+
+    __slots__ = ("param_count", "param_bytes", "kv_bytes_per_token",
+                 "peak_flops", "peak_bw")
+
+    def __init__(self, param_count: int, param_bytes: int, kv_bytes_per_token: float,
+                 peak_flops: Optional[float] = None, peak_bw: Optional[float] = None):
+        self.param_count = max(int(param_count), 1)
+        self.param_bytes = max(int(param_bytes), 1)
+        self.kv_bytes_per_token = max(float(kv_bytes_per_token), 0.0)
+        if peak_flops is None or peak_bw is None:
+            peak_flops, peak_bw = detect_peaks()
+        self.peak_flops = peak_flops
+        self.peak_bw = peak_bw
+
+    def step_cost(self, tokens: int, kv_read_tokens: int) -> Tuple[float, float]:
+        """(flops, bytes) for one dispatch computing ``tokens`` token rows
+        while gathering ``kv_read_tokens`` of resident KV."""
+        flops = 2.0 * self.param_count * tokens
+        bytes_moved = (
+            self.param_bytes
+            + kv_read_tokens * self.kv_bytes_per_token  # gathered context
+            + tokens * self.kv_bytes_per_token  # written KV rows
+        )
+        return flops, bytes_moved
+
+
+class _PhaseRoofline:
+    """Rolling (flops, bytes, seconds) account per phase: the live-gauge
+    window. A bounded deque of recent steps, so a quiet engine's MFU decays
+    to reflect recent traffic rather than all-time averages."""
+
+    __slots__ = ("recent", "flops_total", "bytes_total")
+
+    def __init__(self, maxlen: int = 256):
+        self.recent: deque = deque(maxlen=maxlen)  # (flops, bytes, dur_s)
+        self.flops_total = 0.0
+        self.bytes_total = 0.0
+
+    def record(self, flops: float, bytes_moved: float, dur_s: float) -> None:
+        self.recent.append((flops, bytes_moved, dur_s))
+        self.flops_total += flops
+        self.bytes_total += bytes_moved
+
+    def live(self, peak_flops: float, peak_bw: float) -> Tuple[float, float]:
+        """(MFU, HBM-roofline fraction) over the recent-step window."""
+        if not self.recent:
+            return 0.0, 0.0
+        f = sum(x for x, _, _ in self.recent)
+        b = sum(x for _, x, _ in self.recent)
+        t = sum(x for _, _, x in self.recent)
+        if t <= 0:
+            return 0.0, 0.0
+        return f / t / peak_flops, b / t / peak_bw
+
+
 class FlightRecorder:
     """Owned by one Scheduler; mutated on the step thread only."""
 
-    def __init__(self) -> None:
+    def __init__(self, telemetry=None) -> None:
         self._hists: Dict[str, _PhaseHist] = {p: _PhaseHist() for p in PHASES}
+        # Optional runtime.telemetry.Telemetry: record_step feeds per-phase
+        # ``{phase}_step`` digests so step-duration percentiles merge
+        # fleet-wide (the bucket histograms above stay for bench readers).
+        self.telemetry = telemetry
+        # Per-step FLOPs+bytes roofline account (set_cost_model); None keeps
+        # record_step cost-free for schedulers that never attach one.
+        self.cost_model: Optional[StepCostModel] = None
+        self._roofline: Dict[str, _PhaseRoofline] = {}
+        # Stall watchdog reference point + /debug/state step timeline.
+        self.last_step_ts: Optional[float] = None
+        self.recent_steps: deque = deque(maxlen=64)  # (ts, phase, dur_s, tokens)
         # Decode host gap: time from a decode dispatch RETURNING (device
         # launched, host free) to the NEXT decode dispatch being issued —
         # the bubble the overlap pipeline exists to close. Only consecutive
@@ -105,13 +215,40 @@ class FlightRecorder:
         self.last_step_s = 0.0
 
     # --- step accounting ----------------------------------------------------
-    def record_step(self, phase: str, dur_s: float, tokens: int) -> None:
+    def set_cost_model(self, model: StepCostModel) -> None:
+        """Attach the per-step FLOPs+bytes model: record_step then keeps a
+        live per-phase MFU / HBM-roofline account."""
+        self.cost_model = model
+
+    def record_step(
+        self, phase: str, dur_s: float, tokens: int, kv_read_tokens: int = 0
+    ) -> None:
         h = self._hists.get(phase)
         if h is None:
             h = self._hists.setdefault(phase, _PhaseHist())
         h.observe(dur_s, tokens)
         self.last_step_phase = phase
         self.last_step_s = dur_s
+        self.last_step_ts = time.monotonic()
+        self.recent_steps.append((self.last_step_ts, phase, round(dur_s, 6), tokens))
+        if self.telemetry is not None:
+            self.telemetry.observe(f"{phase}_step", dur_s)
+        if self.cost_model is not None:
+            flops, bytes_moved = self.cost_model.step_cost(tokens, kv_read_tokens)
+            r = self._roofline.get(phase)
+            if r is None:
+                r = self._roofline.setdefault(phase, _PhaseRoofline())
+            r.record(flops, bytes_moved, dur_s)
+
+    def utilization(self) -> Dict[str, Tuple[float, float]]:
+        """{phase: (mfu, hbm_roofline_fraction)} over the recent-step
+        window; empty without a cost model."""
+        if self.cost_model is None:
+            return {}
+        return {
+            phase: r.live(self.cost_model.peak_flops, self.cost_model.peak_bw)
+            for phase, r in self._roofline.items()
+        }
 
     def record_host_gap(self, gap_s: float) -> None:
         """One dispatch-return → next-dispatch interval on the decode path."""
@@ -169,6 +306,13 @@ class FlightRecorder:
             out[f"step_{phase}_steps_total"] = h.total
             out[f"step_{phase}_time_seconds_total"] = round(h.sum_s, 6)
             out[f"step_{phase}_tokens_total"] = h.tokens
+        if self.cost_model is not None:
+            for phase, r in self._roofline.items():
+                out[f"step_{phase}_flops_total"] = round(r.flops_total, 1)
+                out[f"step_{phase}_bytes_total"] = round(r.bytes_total, 1)
+                mfu, hbm = r.live(self.cost_model.peak_flops, self.cost_model.peak_bw)
+                out[f"mfu_{phase}"] = round(mfu, 6)
+                out[f"hbm_frac_{phase}"] = round(hbm, 6)
         return out
 
     def histogram(self, phase: str) -> Tuple[Tuple[float, ...], List[int]]:

@@ -39,8 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.config import ModelConfig
-from dynamo_tpu.engine.flight_recorder import FlightRecorder, StepTimer
+from dynamo_tpu.engine.flight_recorder import FlightRecorder, StepCostModel, StepTimer
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays, KvEvent, OutOfBlocksError
+from dynamo_tpu.runtime.telemetry import SloConfig, SloJudge, Telemetry
 from dynamo_tpu.engine.models import llama
 from dynamo_tpu.engine.sampling import SamplingParams, guided_sample_batch, sample_batch
 from dynamo_tpu.llm.tokens import extend_block_hashes
@@ -256,6 +257,18 @@ class SchedulerConfig:
     # states of live grammars fit, guided rows add no post-warmup compiles.
     # Overflow doubles the pool (pow2 buckets, one recompile, logged).
     guided_pool_rows: int = 1024
+    # SLA telemetry: per-request latency targets (None = phase unjudged).
+    # Every finished request's TTFT/TPOT is judged against these, feeding
+    # the slo_*_total counters and the goodput account the planner reads.
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    # Rolling window for the quantile-gauge snapshots (digest totals stay
+    # cumulative for the aggregator's Prometheus histogram re-export).
+    telemetry_window_s: float = 60.0
+    # Stall watchdog: the step loop not completing a step for this long
+    # while work is queued marks the engine stalled (unhealthy /health,
+    # engine_stalled counter). Sized well past any legitimate cold compile.
+    stall_after_s: float = 120.0
 
 
 @dataclass
@@ -367,12 +380,27 @@ class Scheduler:
         self._eos = eos_token_ids or []
         self._rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
+        # SLA telemetry: mergeable latency digests (ttft/tpot/itl/queue_wait
+        # + per-phase step durations via the flight recorder) and the SLO
+        # judge behind the goodput account. All host-side — no dispatches.
+        self.telemetry = Telemetry(window_s=self.sc.telemetry_window_s)
+        self.slo = SloJudge(SloConfig(ttft_ms=self.sc.slo_ttft_ms, tpot_ms=self.sc.slo_tpot_ms))
         # Flight recorder: per-phase step histograms + XLA compile tracker
         # (every dispatch registers its shape key; keys first seen after
         # warmup are counted/logged). Tracer: per-request lifecycle events
         # for sequences whose trace is sampled.
-        self.flight = FlightRecorder()
+        self.flight = FlightRecorder(telemetry=self.telemetry)
         self.tracer = get_tracer()
+        # Per-step FLOPs+bytes roofline model from the REAL params/cache
+        # byte widths (int8 weights/KV are modeled as stored): BENCH
+        # roofline numbers become the live mfu_*/hbm_frac_* gauges.
+        p_leaves = jax.tree_util.tree_leaves(params)
+        param_count = sum(int(x.size) for x in p_leaves)
+        param_bytes = sum(int(x.size) * x.dtype.itemsize for x in p_leaves)
+        kv_leaves = jax.tree_util.tree_leaves((self.cache.k, self.cache.v))
+        kv_bytes = sum(int(x.size) * x.dtype.itemsize for x in kv_leaves)
+        kv_per_token = kv_bytes / max(self.sc.num_blocks * model_config.block_size, 1)
+        self.flight.set_cost_model(StepCostModel(param_count, param_bytes, kv_per_token))
 
         # Trim buckets to the model's max length.
         self.sc.prefill_buckets = [b for b in self.sc.prefill_buckets if b <= model_config.max_seq_len] or [
@@ -736,6 +764,84 @@ class Scheduler:
             prefix_onboard_total=self.prefix_onboard_total,
         )
 
+    def kv_gauges(self) -> dict:
+        """Block-pool utilization for the stats scrape: free/cached depth,
+        internal fragmentation (allocated-but-unwritten slots across live
+        sequences — the padding cost of block-granular allocation), and the
+        prefix-cache hit rate."""
+        a = self.allocator
+        bs = self.mc.block_size
+        allocated = 0
+        used = 0
+        for s in list(self.running) + list(self.waiting):
+            nb = len(s.block_ids)
+            if not nb:
+                continue
+            allocated += nb * bs
+            used += min(s.total_len, nb * bs)
+        hits, misses = a.hit_blocks_total, a.miss_blocks_total
+        return {
+            "kv_free_blocks": len(a._free),
+            "kv_cached_blocks": a.num_cached,
+            "kv_fragmentation": round(1.0 - used / allocated, 6) if allocated else 0.0,
+            "prefix_hit_rate": round(hits / (hits + misses), 6) if (hits + misses) else 0.0,
+        }
+
+    def debug_state(self) -> dict:
+        """Live introspection snapshot for /debug/state: every sequence with
+        its age/progress, the block pool, digest percentiles, and the recent
+        step timeline. Read from the event loop while the step thread
+        mutates — last-write-wins races are fine for a debug dump."""
+        now = time.monotonic()
+
+        def seq_info(s: Sequence) -> dict:
+            return {
+                "request_id": s.request_id,
+                "state": s.state.value,
+                "age_s": round(now - s.arrival_ts, 3),
+                "prompt_tokens": len(s.prompt),
+                "output_tokens": len(s.output_ids),
+                "computed": s.num_computed,
+                "cached_tokens": s.cached_tokens,
+                "blocks": len(s.block_ids),
+                "preemptions": s.preemptions,
+            }
+
+        a = self.allocator
+        f = self.flight
+        return {
+            "running": [seq_info(s) for s in list(self.running)],
+            "waiting": [seq_info(s) for s in list(self.waiting)],
+            "block_pool": {
+                "total": a.num_blocks,
+                "free": len(a._free),
+                "cached": a.num_cached,
+                "active": a.num_active,
+                "usage": round(a.usage(), 6),
+                **{k: v for k, v in self.kv_gauges().items() if k == "kv_fragmentation"},
+            },
+            "digests": self.telemetry.summary(),
+            "slo": self.slo.to_stats(),
+            "flight": {
+                "last_step_phase": f.last_step_phase,
+                "last_step_s": round(f.last_step_s, 6),
+                "last_step_age_s": (
+                    round(now - f.last_step_ts, 3) if f.last_step_ts is not None else None
+                ),
+                "compiles_total": f.compiles_total,
+                "compiles_after_warmup_total": f.compiles_after_warmup_total,
+                "post_warmup_keys": [str(k) for k in f.post_warmup_keys[-8:]],
+                "recent_steps": [
+                    {"age_s": round(now - ts, 3), "phase": ph, "dur_s": d, "tokens": t}
+                    for ts, ph, d, t in list(f.recent_steps)
+                ],
+                "utilization": {
+                    ph: {"mfu": round(m, 6), "hbm_frac": round(h, 6)}
+                    for ph, (m, h) in f.utilization().items()
+                },
+            },
+        }
+
     # --- step loop core (runs in worker thread) -----------------------------
     def step(self) -> List[tuple]:
         """One scheduler iteration. Returns [(seq, StepOutput), ...].
@@ -908,7 +1014,11 @@ class Scheduler:
             # Decode rows first (output-order parity with the phase-separated
             # decode-then-admit iteration), then the chunk's progress.
             self._finish_decode_rows(batch, d_bucket, logits[1:], outputs)
-        self.flight.record_step("mixed", timer.dur, len(chunk_tokens) + n)
+        self.flight.record_step(
+            "mixed", timer.dur, len(chunk_tokens) + n,
+            kv_read_tokens=sum(s.total_len for s in batch) + seq.num_computed,
+        )
+        self.telemetry.observe("itl", timer.dur)
         self._trace_event(
             seq, "mixed_ride", chunk_tokens=len(chunk_tokens), decode_rows=n,
             dur_s=round(timer.dur, 6),
@@ -1107,7 +1217,10 @@ class Scheduler:
                 self.running.append(seq)
                 self._register_full_blocks(seq)
                 self._append_token(seq, int(sampled[i]), outputs)
-        self.flight.record_step("wave", timer.dur, int(valid.sum()) + len(admitted))
+        self.flight.record_step(
+            "wave", timer.dur, int(valid.sum()) + len(admitted),
+            kv_read_tokens=int(pos0.sum()),
+        )
         return True
 
     def _first_touch(self, seq: Sequence, pf_tokens: List[int], total_tokens: int) -> None:
@@ -1231,7 +1344,9 @@ class Scheduler:
                     seq.num_computed > 0,
                 )
             logits, self.cache.k, self.cache.v = self._consume_aux(res)
-        self.flight.record_step("prefill", timer.dur, len(tokens))
+        self.flight.record_step(
+            "prefill", timer.dur, len(tokens), kv_read_tokens=seq.num_computed
+        )
         self._trace_event(
             seq, "prefill_chunk", tokens=len(tokens), bucket=bucket,
             computed=seq.num_computed + len(tokens), dur_s=round(timer.dur, 6),
@@ -1697,7 +1812,11 @@ class Scheduler:
                 self._append_token(seq, int(sampled_h[i]), outputs)
                 if seq.state != SeqState.RUNNING:
                     finished = True
-        self.flight.record_step("decode", timer.dur, len(pipe["batch"]))
+        self.flight.record_step(
+            "decode", timer.dur, len(pipe["batch"]),
+            kv_read_tokens=sum(s.total_len for s in pipe["batch"]),
+        )
+        self.telemetry.observe("itl", timer.dur)
         if finished:
             self._overlap_flush(outputs, rollback=rollback)
 
@@ -1819,7 +1938,11 @@ class Scheduler:
             self._note_decode_dispatch()
             logits, self.cache.k, self.cache.v = self._consume_aux(res)
             self._finish_decode_rows(batch, bucket, logits, outputs)
-        self.flight.record_step("decode", timer.dur, len(outputs))
+        self.flight.record_step(
+            "decode", timer.dur, len(outputs),
+            kv_read_tokens=sum(s.total_len for s in batch),
+        )
+        self.telemetry.observe("itl", timer.dur)
         return outputs
 
     def _finish_decode_rows(
@@ -2009,7 +2132,11 @@ class Scheduler:
                     if seq.state != SeqState.RUNNING:
                         break  # stopped mid-window; later tokens are trimmed
                     self._append_token(seq, int(sampled[s, i]), outputs)
-        self.flight.record_step("decode", timer.dur, len(outputs) - n0)
+        self.flight.record_step(
+            "decode", timer.dur, len(outputs) - n0,
+            kv_read_tokens=steps * sum(s.total_len for s in batch),
+        )
+        self.telemetry.observe("itl", timer.dur / max(steps, 1))
         return True
 
     def _decode_spec(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
@@ -2135,7 +2262,10 @@ class Scheduler:
             # inputs covered positions old_total..old_total+γ-2, of which the
             # first min(k, γ-1) carry accepted (confirmed) tokens.
             seq.d_n = old_total + min(k, gamma - 1)
-        self.flight.record_step("spec", time.perf_counter() - t_round, len(outputs) - n0)
+        self.flight.record_step(
+            "spec", time.perf_counter() - t_round, len(outputs) - n0,
+            kv_read_tokens=2 * sum(s.total_len for s in batch),
+        )
         return True
 
     # --- disaggregation support ---------------------------------------------
@@ -2458,10 +2588,13 @@ class Scheduler:
             if seq.admitted_ts is not None:
                 queue_s = max(0.0, seq.admitted_ts - seq.arrival_ts)
                 self.queue_wait_s_total += queue_s
+                self.telemetry.observe("queue_wait", queue_s)
                 if seq.first_token_ts is not None:
                     self.prefill_wait_s_total += max(0.0, seq.first_token_ts - seq.admitted_ts)
             self.first_tokens_total += 1
             cached = seq.cached_tokens
+            ttft_s = max(0.0, (seq.first_token_ts or time.monotonic()) - seq.arrival_ts)
+            self.telemetry.observe("ttft", ttft_s)
             self._trace_event(
                 seq, "first_token",
                 ttft_s=round(time.monotonic() - seq.arrival_ts, 6),
@@ -2516,6 +2649,18 @@ class Scheduler:
         if seq in self.running:
             self.running.remove(seq)
         seq.state = SeqState.FINISHED
+        # Request-level telemetry + the SLO/goodput verdict. Cancelled and
+        # errored requests are not judged (the client walked away; counting
+        # them as violations would let an abort storm fake an SLO breach).
+        if seq.first_token_ts is not None and reason in ("stop", "length"):
+            now = time.monotonic()
+            ttft_s = max(0.0, seq.first_token_ts - seq.arrival_ts)
+            n_out = len(seq.output_ids)
+            tpot_s = None
+            if n_out > 1:
+                tpot_s = max(0.0, now - seq.first_token_ts) / (n_out - 1)
+                self.telemetry.observe("tpot", tpot_s)
+            self.slo.judge(ttft_s, tpot_s, n_out)
         self._trace_event(
             seq, "finish", reason=reason, output_tokens=len(seq.output_ids),
             preemptions=seq.preemptions,

@@ -38,6 +38,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-file", default=None, help="JSONL span export path (enables tracing)")
     p.add_argument("--trace-sample", type=float, default=None,
                    help="trace sampling ratio in [0,1]; decision is per-trace-id (default 1.0)")
+    # SLA telemetry: judge every request's e2e TTFT/TPOT against these
+    # targets — slo_{attained,violated}_total{phase} counters + goodput
+    # (SLO-attained req/s, tok/s) on /metrics.
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms (enables SLO/goodput accounting)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="per-output-token latency SLO target in ms")
     return p
 
 
@@ -57,6 +64,8 @@ async def amain(args) -> None:
         tls_cert=args.tls_cert_path,
         tls_key=args.tls_key_path,
         encode_component=args.encode_component,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_tpot_ms=args.slo_tpot_ms,
     )
     service = await start_frontend(drt, config)
     logger.info("frontend ready on %s:%d (router=%s)", args.http_host, service.port, args.router_mode)

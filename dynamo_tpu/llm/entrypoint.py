@@ -155,6 +155,10 @@ class FrontendConfig:
     # Multimodal: route image parts to the encode-worker pool at this
     # component (ref: trtllm encode_helper.py); None = images rejected.
     encode_component: Optional[str] = None
+    # SLA targets for the frontend's e2e SLO judgments + goodput account
+    # (--slo-ttft-ms/--slo-tpot-ms; None = phase unjudged).
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
 
 
 async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> HttpService:
@@ -195,9 +199,12 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
 
     watcher = ModelWatcher(drt, manager, engine_factory)
     await watcher.start()
+    from dynamo_tpu.runtime.telemetry import SloConfig
+
     service = HttpService(
         manager, host=config.host, port=config.port,
         tls_cert=config.tls_cert, tls_key=config.tls_key,
+        slo=SloConfig(ttft_ms=config.slo_ttft_ms, tpot_ms=config.slo_tpot_ms),
     )
     service.watcher = watcher  # keep alive / stoppable
     await service.start()

@@ -34,8 +34,26 @@ from dynamo_tpu.runtime.metrics import (
     TTFT_BUCKETS,
     MetricsRegistry,
 )
+from dynamo_tpu.runtime.telemetry import (
+    DigestCollector,
+    SloConfig,
+    SloJudge,
+    Telemetry,
+)
 
 logger = get_logger(__name__)
+
+# Digest-exported frontend families (DigestCollector live mode): each stream
+# renders as "<name>_seconds" (native histogram, cumulative) plus
+# "<name>_seconds_quantile" (rolling-window p50/p90/p99 gauges). These are
+# the frontend's OWN end-to-end measurements — client-observed TTFT/TPOT
+# including routing and the serving plane, judged against the same SLO
+# targets the engine judges its internal latencies with.
+FRONTEND_DIGEST_FAMILIES = (
+    "ttft_seconds", "ttft_seconds_quantile",
+    "tpot_seconds", "tpot_seconds_quantile",
+    "request_seconds", "request_seconds_quantile",
+)
 
 
 class HttpService:
@@ -48,6 +66,7 @@ class HttpService:
         metrics: Optional[MetricsRegistry] = None,
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
+        slo: Optional[SloConfig] = None,
     ):
         self.manager = manager
         self.host = host
@@ -97,6 +116,69 @@ class HttpService:
         self._m_cached_tokens = lambda model: m.counter(
             "input_cached_tokens_total", "prompt tokens served from the prefix cache", model=model
         )
+        # SLA telemetry: the frontend's own e2e digests (ttft/tpot/request
+        # — FRONTEND_DIGEST_FAMILIES) and per-request SLO judgments against
+        # --slo-ttft-ms/--slo-tpot-ms. Goodput = SLO-attained req/tok.
+        self.slo = slo or SloConfig()
+        self.telemetry = Telemetry()
+        self._slo_judge = SloJudge(self.slo)
+        self._digest_collector = DigestCollector(
+            FRONTEND_PREFIX, registry=m.registry, telemetry=self.telemetry
+        )
+        self._m_slo = lambda model, phase, verdict: m.counter(
+            "slo_attained_total" if verdict == "attained" else "slo_violated_total",
+            "request phases meeting/missing the SLO target",
+            model=model, phase=phase,
+        )
+        self._m_goodput_requests = lambda model: m.counter(
+            "goodput_requests_total", "requests that attained every configured SLO", model=model
+        )
+        self._m_goodput_tokens = lambda model: m.counter(
+            "goodput_tokens_total", "output tokens of SLO-attained requests", model=model
+        )
+        self._m_goodput_req_s = m.gauge(
+            "goodput_requests_per_s", "SLO-attained requests/s over the rolling window"
+        )
+        self._m_goodput_tok_s = m.gauge(
+            "goodput_tokens_per_s", "SLO-attained output tokens/s over the rolling window"
+        )
+
+    def _record_request_telemetry(
+        self,
+        model: str,
+        start: float,
+        first_at: Optional[float],
+        last_at: Optional[float],
+        n_tokens: int,
+    ) -> None:
+        """End-of-request e2e telemetry: digests + SLO judgment + goodput.
+        Requests that never produced a token (errors, rejections) are not
+        judged — they are failures, not latency violations."""
+        if first_at is None:
+            return
+        now = time.monotonic()
+        ttft_s = max(0.0, first_at - start)
+        self.telemetry.observe("ttft", ttft_s)
+        self.telemetry.observe("request", max(0.0, now - start))
+        tpot_s = None
+        if n_tokens > 1 and last_at is not None and last_at > first_at:
+            tpot_s = (last_at - first_at) / (n_tokens - 1)
+            self.telemetry.observe("tpot", tpot_s)
+        if not self.slo.enabled:
+            return
+        good = self._slo_judge.judge(ttft_s, tpot_s, n_tokens)
+        if self.slo.ttft_ms is not None:
+            verdict = "attained" if ttft_s * 1000.0 <= self.slo.ttft_ms else "violated"
+            self._m_slo(model, "ttft", verdict).inc()
+        if self.slo.tpot_ms is not None and tpot_s is not None:
+            verdict = "attained" if tpot_s * 1000.0 <= self.slo.tpot_ms else "violated"
+            self._m_slo(model, "tpot", verdict).inc()
+        if good:
+            self._m_goodput_requests(model).inc()
+            self._m_goodput_tokens(model).inc(n_tokens)
+        req_s, tok_s = self._slo_judge.goodput_rates()
+        self._m_goodput_req_s.set(req_s)
+        self._m_goodput_tok_s.set(tok_s)
 
     # --- lifecycle ----------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -532,6 +614,7 @@ class HttpService:
         prompt_tokens_box = [0]
         cached_tokens_box = [None]
         first_box = [None]
+        last_box = [None]
 
         async def run_choice(i: int, b: dict, c: Context) -> dict:
             text_parts = []
@@ -554,6 +637,8 @@ class HttpService:
                 out = _as_output(item)
                 if out is None:
                     continue
+                if out.token_ids:
+                    last_box[0] = time.monotonic()
                 if out.text:
                     if first_box[0] is None:
                         first_box[0] = time.monotonic()
@@ -612,6 +697,9 @@ class HttpService:
         self._m_requests(model, "200").inc()
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
+        self._record_request_telemetry(
+            model, start, first_box[0], last_box[0], results[0]["n_tokens"]
+        )
         usage = oai.usage_dict(
             prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens,
             cached_tokens=cached_tokens_box[0],
@@ -653,6 +741,7 @@ class HttpService:
         )
         await resp.prepare(request)
         first = True
+        first_at = None
         prev_tok_at = None
         n_tokens = 0
         status = "200"
@@ -679,6 +768,7 @@ class HttpService:
                     if first:
                         self._m_ttft(model).observe(now - start)
                         first = False
+                        first_at = now
                     elif prev_tok_at is not None:
                         self._m_itl(model).observe(now - prev_tok_at)
                     prev_tok_at = now
@@ -725,6 +815,8 @@ class HttpService:
         finally:
             self._m_requests(model, status).inc()
             self._m_output_tokens(model).inc(n_tokens)
+            if status == "200":
+                self._record_request_telemetry(model, start, first_at, prev_tok_at, n_tokens)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp

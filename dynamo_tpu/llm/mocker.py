@@ -29,6 +29,7 @@ than simulating per-request in isolation:
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, List, Optional
 
@@ -37,6 +38,7 @@ from dynamo_tpu.engine.scheduler import ForwardPassMetrics
 from dynamo_tpu.llm.tokens import compute_block_hashes
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.telemetry import SloConfig, SloJudge, Telemetry
 
 logger = get_logger(__name__)
 
@@ -60,6 +62,11 @@ class MockEngineArgs:
     prefill_base_ms: float = 0.5
     prefill_per_token_us: float = 40.0
     max_prefill_chunk: int = 2048
+    # SLA telemetry: same knobs as SchedulerConfig — the mocker judges its
+    # (wall-clock) TTFT/TPOT against these and exports the same digest/SLO
+    # stats keys, so planner tests and traffic harnesses run engine-free.
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
     # Back-compat aliases used by older callers/flags.
     prefill_time_per_token_ms: Optional[float] = None
     decode_time_per_token_ms: Optional[float] = None
@@ -94,6 +101,9 @@ class _Seq:
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.context = context
+        self.arrival_ts = time.monotonic()
+        self.admitted_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
         # Guided decoding: the exact token stream to emit (a grammar-valid
         # rendering of the request's constraint) instead of prompt cycling.
         self.forced = forced
@@ -148,6 +158,14 @@ class MockTpuEngine:
         self.preempt_total = 0
         self.cached_tokens_total = 0  # prefix-cache hit tokens (hit-rate telemetry)
         self.last_step_ms = 0.0  # most recent simulated step duration
+        self.last_step_ts: Optional[float] = None  # stall-watchdog reference
+        # Same telemetry surface as the real engine (runtime/telemetry.py):
+        # wall-clock ttft/tpot/itl/queue_wait digests + SLO/goodput account,
+        # exported under the same stats keys so planner and traffic-harness
+        # stacks observe a mocker fleet exactly like an engine fleet.
+        self.telemetry = Telemetry()
+        self.slo = SloJudge(SloConfig(ttft_ms=self.args.slo_ttft_ms,
+                                      tpot_ms=self.args.slo_tpot_ms))
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
 
@@ -254,6 +272,11 @@ class MockTpuEngine:
 
             self.last_step_ms = step_ms
             await asyncio.sleep(step_ms / 1000.0 / args.speedup_ratio)
+            self.last_step_ts = time.monotonic()
+            if decoding:
+                # Wall-clock step time = the ITL the wire observes.
+                self.telemetry.observe("itl", step_ms / 1000.0 / args.speedup_ratio)
+                self.telemetry.observe("decode_step", step_ms / 1000.0 / args.speedup_ratio)
 
             for s in list(decoding):
                 if s not in self.running:
@@ -281,6 +304,10 @@ class MockTpuEngine:
                     finish = "length" if s.generated >= s.max_tokens else None
                 frame = {"token_ids": [token], "finish_reason": finish, "index": 0}
                 if s.generated == 1:
+                    s.first_token_ts = time.monotonic()
+                    self.telemetry.observe(
+                        "ttft", max(0.0, s.first_token_ts - s.arrival_ts)
+                    )
                     # First frame carries the real engine's reuse report:
                     # prompt tokens whose simulated prefill was skipped by
                     # the prefix cache (the wire shape router/frontend
@@ -288,6 +315,16 @@ class MockTpuEngine:
                     frame["cached_tokens"] = s.cached_tokens
                 s.out.put_nowait(frame)
                 if finish:
+                    # Natural finish: judge SLA (cancelled requests aren't
+                    # latency violations) and fold TPOT into the digests.
+                    if s.first_token_ts is not None:
+                        now = time.monotonic()
+                        ttft_s = max(0.0, s.first_token_ts - s.arrival_ts)
+                        tpot_s = None
+                        if s.generated > 1:
+                            tpot_s = max(0.0, now - s.first_token_ts) / (s.generated - 1)
+                            self.telemetry.observe("tpot", tpot_s)
+                        self.slo.judge(ttft_s, tpot_s, s.generated)
                     self._finish(s)
             if not (self.waiting or self.running):
                 # Wait briefly for new arrivals before exiting the loop task.
@@ -352,6 +389,11 @@ class MockTpuEngine:
             # admission retries and would double-count (which inflated the
             # thrash-prone policy's hit rate in bench_router_prefix).
             self.cached_tokens_total += seq.cached_tokens
+            if seq.admitted_ts is None:
+                seq.admitted_ts = time.monotonic()
+                self.telemetry.observe(
+                    "queue_wait", max(0.0, seq.admitted_ts - seq.arrival_ts)
+                )
         remaining = seq.prefill_span - seq.computed
         chunk = min(remaining, args.max_prefill_chunk)
         if budget is not None:
@@ -437,7 +479,9 @@ class MockTpuEngine:
 
     def stats_handler(self) -> dict:
         m = self.metrics()
-        return {
+        a = self.allocator
+        hits, misses = a.hit_blocks_total, a.miss_blocks_total
+        stats = {
             "kv_usage": m.kv_usage,
             "num_running": m.num_running,
             "num_waiting": m.num_waiting,
@@ -447,4 +491,16 @@ class MockTpuEngine:
             "prefix_hit_blocks_total": m.prefix_hit_blocks_total,
             "prefix_miss_blocks_total": m.prefix_miss_blocks_total,
             "prefix_evicted_blocks_total": m.prefix_evicted_blocks_total,
+            # Utilization gauges, same keys as Scheduler.kv_gauges().
+            "kv_free_blocks": len(a._free),
+            "kv_cached_blocks": a.num_cached,
+            "prefix_hit_rate": round(hits / (hits + misses), 6) if (hits + misses) else 0.0,
+            "preemptions_total": self.preempt_total,
+            "request_total": self.request_total,
         }
+        # SLO/goodput account + latency digests: identical keys/shape to
+        # TpuEngine.stats_handler, so the aggregator/planner/observer stack
+        # can run against pure mocker fleets.
+        stats.update(self.slo.to_stats())
+        stats["digests"] = self.telemetry.to_wire()
+        return stats

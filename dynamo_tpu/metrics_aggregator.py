@@ -16,6 +16,7 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer, HEALTHY
 from dynamo_tpu.runtime.logging import get_logger, init_logging
 from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.telemetry import DigestCollector
 
 logger = get_logger(__name__)
 
@@ -25,7 +26,29 @@ GAUGE_KEYS = (
     "kv_usage", "kv_total_blocks", "kv_active_blocks",
     "num_running", "num_waiting", "in_flight",
     "remote_prefills", "local_prefills",
+    # KV-pool utilization (free/cached depth, internal fragmentation) and
+    # the prefix-cache hit rate — the load-skew signals elastic
+    # prefill/decode rebalancing observes.
+    "kv_free_blocks", "kv_cached_blocks", "kv_fragmentation", "prefix_hit_rate",
+    # SLO attainment + live goodput rates (the SloJudge rolling window).
+    "slo_attainment", "goodput_req_per_s", "goodput_tok_per_s",
+    # Live roofline estimates per phase (flight-recorder FLOPs+bytes model).
+    "mfu_prefill", "mfu_decode", "mfu_mixed", "mfu_wave", "mfu_spec",
+    "hbm_frac_prefill", "hbm_frac_decode", "hbm_frac_mixed",
+    "hbm_frac_wave", "hbm_frac_spec",
+    # Stall watchdog: 1.0 = step loop wedged with work queued.
+    "engine_stalled", "last_step_age_s",
 )
+
+# Fleet-level digest families the aggregator re-exports (merged across
+# workers): each becomes ``dynamo_component_fleet_<name>_seconds`` (native
+# histogram, cumulative) + ``..._seconds_quantile`` (windowed p50/p90/p99
+# gauges). Workers may export any subset; unknown names flow through too.
+DIGEST_KEYS = (
+    "ttft", "tpot", "itl", "queue_wait",
+    "prefill_step", "decode_step", "mixed_step", "wave_step", "spec_step",
+)
+FLEET_DIGEST_PREFIX = "dynamo_component_fleet_"
 
 # Monotonic worker stats → Counters (``rate()``-able; a Gauge here breaks
 # PromQL rate/increase semantics). The scrape sees running totals, so the
@@ -49,6 +72,20 @@ COUNTER_KEYS = (
     "step_mixed_steps_total", "step_mixed_time_seconds_total", "step_mixed_tokens_total",
     "step_wave_steps_total", "step_wave_time_seconds_total", "step_wave_tokens_total",
     "step_spec_steps_total", "step_spec_time_seconds_total", "step_spec_tokens_total",
+    # SLO attainment + goodput (SLO-attained requests/tokens; rate() gives
+    # goodput req/s and tok/s over any window).
+    "slo_ttft_attained_total", "slo_ttft_violated_total",
+    "slo_tpot_attained_total", "slo_tpot_violated_total",
+    "goodput_requests_total", "goodput_tokens_total",
+    # Per-phase FLOPs/bytes from the flight-recorder cost model: rate()
+    # against the chip peaks gives MFU / HBM-roofline fraction in PromQL.
+    "step_prefill_flops_total", "step_prefill_bytes_total",
+    "step_decode_flops_total", "step_decode_bytes_total",
+    "step_mixed_flops_total", "step_mixed_bytes_total",
+    "step_wave_flops_total", "step_wave_bytes_total",
+    "step_spec_flops_total", "step_spec_bytes_total",
+    # Stall watchdog transitions (each is one wedged-engine incident).
+    "engine_stalls_total",
 )
 
 
@@ -60,6 +97,11 @@ class MetricsAggregator:
         self.endpoint_name = endpoint
         self.interval_s = interval_s
         self.registry = MetricsRegistry(labels={"namespace": namespace, "component": component})
+        # Fleet-merged latency digests: per-worker wire sketches merge
+        # bucket-wise into TRUE fleet quantiles (averaging per-worker p99s
+        # does not compose), re-exported as native Prometheus histograms +
+        # quantile gauges under dynamo_component_fleet_*.
+        self.digests = DigestCollector(FLEET_DIGEST_PREFIX, registry=self.registry.registry)
         self._task: Optional[asyncio.Task] = None
         self.client = None
         # Last-seen totals per (worker, key) for Counter delta export.
@@ -91,6 +133,9 @@ class MetricsAggregator:
                 else:
                     c.inc(cur - prev)
                 self._last[(wid, key)] = cur
+        self.digests.update_from_wire(
+            s.get("digests") for s in stats.values() if isinstance(s.get("digests"), dict)
+        )
 
     async def _loop(self) -> None:
         try:

@@ -1,65 +1,190 @@
-"""Frontend metrics observation for the planner.
+"""Metrics observation for the planner.
 
-Ref: planner_core.py ``observe_metrics`` (:193) — reads the frontend's
-Prometheus endpoint and derives per-interval request rate, average ISL, and
-average OSL from counter deltas.
+Ref: planner_core.py ``observe_metrics`` (:193) — reads Prometheus
+endpoints and derives the planner's control inputs. Two layers:
+
+- **Counters → per-window rates**: request rate, average ISL/OSL, SLO
+  attainment and goodput from counter deltas between polls.
+- **Digest quantile gauges → latency distributions**: the frontend and the
+  metrics aggregator export fleet-merged digest quantiles
+  (``*_seconds_quantile{quantile="0.99"}``); the observer lifts them into
+  ``ObservedLoad.ttft_p99`` etc. — the signals SLA-driven autoscaling
+  actually inverts, rather than averages.
+
+``parse_prometheus_samples`` is a real text-exposition parser: labeled
+series, histogram/summary sample families (``_bucket``/``_sum``/
+``_count``/``quantile``), escaped label values, exponent/NaN/Inf values.
+The old regex silently dropped anything it did not match, which is how a
+planner ends up steering on zeros.
 """
 
 from __future__ import annotations
 
+import math
 import re
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 import aiohttp
 
 from dynamo_tpu.planner.planner_core import ObservedLoad
 
-_METRIC_RE = re.compile(r"^(\w+)(?:\{([^}]*)\})?\s+([0-9.eE+-]+)$")
+# name, optional {labels}, value, optional timestamp. Value is \S+ so
+# exponents, NaN, +Inf/-Inf all parse (float() handles every Prometheus
+# value literal: "NaN", "+Inf", "1e+05", ...).
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+-?\d+)?$"
+)
+# label="value" with \" \\ \n escapes (the exposition-format escape set).
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
-def parse_prometheus(text: str) -> Dict[str, float]:
-    """Sum metric families across label sets (model-agnostic totals)."""
-    out: Dict[str, float] = {}
+class Sample(NamedTuple):
+    name: str
+    labels: Dict[str, str]
+    value: float
+
+
+def _unescape(v: str) -> str:
+    return v.replace(r"\"", '"').replace(r"\n", "\n").replace("\\\\", "\\")
+
+
+def parse_prometheus_samples(text: str) -> List[Sample]:
+    """Every sample line in the exposition, labels preserved. Histogram and
+    summary children appear under their sample names (``x_bucket``,
+    ``x_sum``, ``x_count``, ``x{quantile=...}``)."""
+    out: List[Sample] = []
     for line in text.splitlines():
-        if line.startswith("#"):
+        line = line.strip()
+        if not line or line.startswith("#"):
             continue
-        m = _METRIC_RE.match(line.strip())
-        if m:
-            name, _, value = m.groups()
-            out[name] = out.get(name, 0.0) + float(value)
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        if raw_labels:
+            for lm in _LABEL_RE.finditer(raw_labels):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+        out.append(Sample(name, labels, value))
     return out
 
 
-class PrometheusObserver:
-    """Polls the frontend /metrics and yields ObservedLoad deltas."""
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Sum metric families across label sets (model-agnostic totals). NaN
+    samples are skipped — one uninitialized gauge must not poison a sum."""
+    out: Dict[str, float] = {}
+    for s in parse_prometheus_samples(text):
+        if math.isnan(s.value):
+            continue
+        out[s.name] = out.get(s.name, 0.0) + s.value
+    return out
 
-    def __init__(self, metrics_url: str):
-        self.metrics_url = metrics_url
+
+def _finite(samples: Iterable[Sample]) -> List[Sample]:
+    return [s for s in samples if math.isfinite(s.value)]
+
+
+class PrometheusObserver:
+    """Polls one or more Prometheus endpoints and yields ObservedLoad.
+
+    Typically two URLs: the frontend ``/metrics`` (request counters + its
+    own e2e digest quantiles/SLO account) and the metrics aggregator
+    (fleet-merged engine digests, KV utilization). One URL works when that
+    endpoint exports everything."""
+
+    def __init__(self, metrics_url: str, extra_urls: Sequence[str] = ()):
+        self.urls = [metrics_url, *extra_urls]
         self._last: Optional[Dict[str, float]] = None
         self._last_ts: Optional[float] = None
 
-    async def observe(self) -> ObservedLoad:
+    @property
+    def metrics_url(self) -> str:
+        return self.urls[0]
+
+    async def _fetch(self) -> str:
+        parts = []
         async with aiohttp.ClientSession() as session:
-            async with session.get(self.metrics_url) as resp:
-                text = await resp.text()
-        now = time.monotonic()
-        cur = parse_prometheus(text)
+            for url in self.urls:
+                async with session.get(url) as resp:
+                    parts.append(await resp.text())
+        return "\n".join(parts)
+
+    # --- signal extraction (separated so tests can drive from text) ---------
+    @staticmethod
+    def _quantile(samples: List[Sample], stream: str, q: str) -> float:
+        """Max across sources of ``*<stream>_seconds_quantile{quantile=q}``
+        — with one merged fleet gauge this is that gauge; with several
+        sources (frontend e2e + engine fleet), the planner should react to
+        the worst."""
+        suffix = f"{stream}_seconds_quantile"
+        vals = [
+            s.value for s in _finite(samples)
+            if s.name.endswith(suffix) and s.labels.get("quantile") == q
+        ]
+        return max(vals) if vals else 0.0
+
+    @staticmethod
+    def _gauge_mean(samples: List[Sample], suffix: str) -> float:
+        vals = [s.value for s in _finite(samples) if s.name.endswith(suffix)]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def load_from_text(self, text: str, now: Optional[float] = None) -> ObservedLoad:
+        """Fold one scrape into the delta state and derive the load. The
+        first call establishes the baseline and returns a default load."""
+        now = time.monotonic() if now is None else now
+        samples = parse_prometheus_samples(text)
+        cur: Dict[str, float] = {}
+        for s in samples:
+            if math.isnan(s.value):
+                continue
+            cur[s.name] = cur.get(s.name, 0.0) + s.value
+
         load = ObservedLoad()
         if self._last is not None and self._last_ts is not None:
             dt = max(now - self._last_ts, 1e-6)
+            last = self._last
 
             def delta(name: str) -> float:
-                return max(0.0, cur.get(name, 0.0) - self._last.get(name, 0.0))
+                return max(0.0, cur.get(name, 0.0) - last.get(name, 0.0))
+
+            def delta_suffix(suffix: str) -> float:
+                return sum(
+                    max(0.0, v - last.get(name, 0.0))
+                    for name, v in cur.items() if name.endswith(suffix)
+                )
 
             d_req = delta("dynamo_frontend_requests_total")
             d_in = delta("dynamo_frontend_input_tokens_total")
             d_out = delta("dynamo_frontend_output_tokens_total")
+            # SLO attainment over THIS window (counter deltas, all sources:
+            # frontend phase-labeled + worker flat keys both end in
+            # slo_*attained_total / slo_*violated_total).
+            d_att = delta_suffix("slo_attained_total") + delta_suffix("slo_ttft_attained_total") \
+                + delta_suffix("slo_tpot_attained_total")
+            d_vio = delta_suffix("slo_violated_total") + delta_suffix("slo_ttft_violated_total") \
+                + delta_suffix("slo_tpot_violated_total")
             load = ObservedLoad(
                 request_rate=d_req / dt,
                 avg_isl=d_in / d_req if d_req > 0 else 0.0,
                 avg_osl=d_out / d_req if d_req > 0 else 0.0,
+                ttft_p50=self._quantile(samples, "ttft", "0.5"),
+                ttft_p90=self._quantile(samples, "ttft", "0.9"),
+                ttft_p99=self._quantile(samples, "ttft", "0.99"),
+                tpot_p99=self._quantile(samples, "tpot", "0.99"),
+                queue_wait_p99=self._quantile(samples, "queue_wait", "0.99"),
+                slo_attainment=(d_att / (d_att + d_vio)) if (d_att + d_vio) > 0 else 1.0,
+                goodput_req_s=delta_suffix("goodput_requests_total") / dt,
+                goodput_tok_s=delta_suffix("goodput_tokens_total") / dt,
+                kv_util=self._gauge_mean(samples, "_kv_usage"),
             )
         self._last = cur
         self._last_ts = now
         return load
+
+    async def observe(self) -> ObservedLoad:
+        return self.load_from_text(await self._fetch())

@@ -41,12 +41,32 @@ class SlaTargets:
 
 @dataclass
 class ObservedLoad:
-    """One observation window from the frontend metrics
-    (ref: observe_metrics planner_core.py:193)."""
+    """One observation window from the frontend + aggregator metrics
+    (ref: observe_metrics planner_core.py:193).
+
+    Beyond the rate/shape deltas, the load now carries the distribution
+    signals SLA-driven scaling actually consumes (arXiv:2508.19559): TTFT/
+    TPOT/queue-wait quantiles from the fleet-merged digests, the SLO
+    attainment + goodput account, and KV utilization (the warmth signal
+    that makes scale-down decisions KV-cache-aware)."""
 
     request_rate: float = 0.0  # req/s
     avg_isl: float = 0.0  # input tokens per request
     avg_osl: float = 0.0  # output tokens per request
+    # Latency quantiles (seconds) from digest quantile gauges; 0.0 = no data.
+    ttft_p50: float = 0.0
+    ttft_p90: float = 0.0
+    ttft_p99: float = 0.0
+    tpot_p99: float = 0.0
+    queue_wait_p99: float = 0.0
+    # SLO attainment over the window (judged phase checks that met target);
+    # 1.0 with no data so an idle fleet never looks like an SLO breach.
+    slo_attainment: float = 1.0
+    # Goodput: SLO-attained requests/tokens per second over the window.
+    goodput_req_s: float = 0.0
+    goodput_tok_s: float = 0.0
+    # Mean KV-pool usage across workers (0..1).
+    kv_util: float = 0.0
 
 
 @dataclass
@@ -126,8 +146,11 @@ class Planner:
         plan = self.compute_replicas(predicted)
         if self.last_plan is None or plan != self.last_plan:
             logger.info(
-                "planner: rate=%.2f isl=%.0f osl=%.0f -> prefill=%d decode=%d",
-                predicted.request_rate, predicted.avg_isl, predicted.avg_osl, plan.prefill, plan.decode,
+                "planner: rate=%.2f isl=%.0f osl=%.0f ttft_p99=%.3fs tpot_p99=%.4fs "
+                "slo=%.2f goodput=%.2freq/s kv=%.2f -> prefill=%d decode=%d",
+                predicted.request_rate, predicted.avg_isl, predicted.avg_osl,
+                load.ttft_p99, load.tpot_p99, load.slo_attainment,
+                load.goodput_req_s, load.kv_util, plan.prefill, plan.decode,
             )
             await self.connector.set_replicas(PREFILL_COMPONENT, plan.prefill)
             await self.connector.set_replicas(DECODE_COMPONENT, plan.decode)

@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import asyncio
 import json
-from typing import Dict, Optional
+import sys
+import threading
+import traceback
+from typing import Callable, Dict, Optional
 
 from aiohttp import web
 
@@ -24,12 +27,19 @@ UNHEALTHY = "notready"
 
 
 class SystemHealth:
-    """Tracks process + per-endpoint health (ref: lib.rs:81-174)."""
+    """Tracks process + per-endpoint health (ref: lib.rs:81-174).
+
+    ``attach_engine`` adds engine liveness to readiness: the probe returns
+    the watchdog/flight stats (``engine_stalled``, ``last_step_age_s``,
+    ``compiles_after_warmup_total``); a stalled engine reports notready
+    even while the process itself is up — exactly the state where routing
+    more traffic at the worker makes things worse."""
 
     def __init__(self, starting_status: str = UNHEALTHY, use_endpoint_health: bool = False):
         self.system_status = starting_status
         self.use_endpoint_health = use_endpoint_health
         self.endpoints: Dict[str, str] = {}
+        self._engine_probe: Optional[Callable[[], dict]] = None
 
     def set_system_ready(self) -> None:
         self.system_status = HEALTHY
@@ -40,17 +50,37 @@ class SystemHealth:
     def remove_endpoint(self, endpoint_path: str) -> None:
         self.endpoints.pop(endpoint_path, None)
 
+    def attach_engine(self, probe: Callable[[], dict]) -> None:
+        """``probe()`` → dict with ``engine_stalled`` (0/1) plus any extra
+        liveness fields to surface on /health."""
+        self._engine_probe = probe
+
+    def _engine_state(self) -> Optional[dict]:
+        if self._engine_probe is None:
+            return None
+        try:
+            return self._engine_probe()
+        except Exception as e:  # noqa: BLE001 — health must answer regardless
+            return {"engine_stalled": 1.0, "probe_error": str(e)}
+
     def is_healthy(self) -> bool:
+        engine = self._engine_state()
+        if engine is not None and engine.get("engine_stalled"):
+            return False
         if self.use_endpoint_health:
             return bool(self.endpoints) and all(s == HEALTHY for s in self.endpoints.values())
         return self.system_status == HEALTHY
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "status": HEALTHY if self.is_healthy() else UNHEALTHY,
             "system": self.system_status,
             "endpoints": dict(self.endpoints),
         }
+        engine = self._engine_state()
+        if engine is not None:
+            out["engine"] = engine
+        return out
 
 
 class SystemStatusServer:
@@ -59,10 +89,15 @@ class SystemStatusServer:
         health: SystemHealth,
         metrics: Optional[MetricsRegistry] = None,
         config: Optional[SystemConfig] = None,
+        state_probe: Optional[Callable[[], dict]] = None,
     ):
         self.health = health
         self.metrics = metrics
         self.config = config or SystemConfig()
+        # Live introspection source for /debug/state (e.g.
+        # TpuEngine.debug_state): running/waiting sequences, block pool,
+        # digest snapshots, the recent step timeline.
+        self.state_probe = state_probe
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
@@ -71,6 +106,8 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/state", self._debug_state)
+        app.router.add_get("/debug/stacks", self._debug_stacks)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.host, self.config.port)
@@ -89,6 +126,35 @@ class SystemStatusServer:
     async def _metrics(self, request: web.Request) -> web.Response:
         body = self.metrics.render() if self.metrics is not None else b""
         return web.Response(status=200, body=body, content_type="text/plain")
+
+    async def _debug_state(self, request: web.Request) -> web.Response:
+        """Live engine introspection: the "what is the engine doing RIGHT
+        NOW" dump for incident debugging — no scrape interval, no
+        aggregation delay."""
+        if self.state_probe is None:
+            return web.Response(
+                status=404,
+                text=json.dumps({"error": "no state probe attached"}),
+                content_type="application/json",
+            )
+        try:
+            state = self.state_probe()
+        except Exception as e:  # noqa: BLE001 — debug surface must not 500-loop
+            state = {"error": f"{type(e).__name__}: {e}"}
+        return web.Response(
+            status=200, text=json.dumps(state, default=str), content_type="application/json"
+        )
+
+    async def _debug_stacks(self, request: web.Request) -> web.Response:
+        """Python stacks of every thread — the first question when the step
+        loop wedges (is it blocked in a dispatch? a lock? the allocator?)."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = {}
+        for tid, frame in sys._current_frames().items():
+            stacks[f"{names.get(tid, '?')}-{tid}"] = traceback.format_stack(frame)
+        return web.Response(
+            status=200, text=json.dumps(stacks), content_type="application/json"
+        )
 
     async def stop(self) -> None:
         if self._runner is not None:

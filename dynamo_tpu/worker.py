@@ -84,6 +84,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-ctx", type=int, default=0,
                    help="precompile serving executables for contexts up to this many tokens "
                         "(0 = lazy; the flight recorder then counts mid-traffic compiles)")
+    # SLA telemetry (runtime/telemetry.py): per-request SLO judgments +
+    # goodput accounting against these engine-side latency targets.
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms (enables slo_*/goodput counters)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="per-output-token latency SLO target in ms")
+    p.add_argument("--stall-after-s", type=float, default=120.0,
+                   help="watchdog: step loop idle this long with work queued => engine_stalled")
+    p.add_argument("--health-port", type=int, default=None,
+                   help="serve /health + /metrics + /debug/state on this port (0 = ephemeral)")
     return p
 
 
@@ -118,7 +128,11 @@ async def amain(args) -> None:
 
     if args.mocker:
         engine = MockTpuEngine(
-            MockEngineArgs(num_blocks=args.num_blocks, block_size=args.block_size, speedup_ratio=args.speedup_ratio)
+            MockEngineArgs(
+                num_blocks=args.num_blocks, block_size=args.block_size,
+                speedup_ratio=args.speedup_ratio,
+                slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
+            )
         )
     else:
         parallel = None
@@ -139,7 +153,11 @@ async def amain(args) -> None:
                 kvbm_host_blocks=args.kvbm_host_blocks,
                 kvbm_disk_dir=args.kvbm_disk_dir,
                 kvbm_disk_blocks=args.kvbm_disk_blocks,
-                scheduler=SchedulerConfig(num_blocks=args.num_blocks, max_running=args.max_running),
+                scheduler=SchedulerConfig(
+                    num_blocks=args.num_blocks, max_running=args.max_running,
+                    slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
+                    stall_after_s=args.stall_after_s,
+                ),
                 parallel=parallel,
                 draft_model=args.draft_model,
                 draft_checkpoint_path=args.draft_checkpoint,
@@ -203,10 +221,38 @@ async def amain(args) -> None:
         kvx = KvExportService(drt, engine, handle.instance)
         await kvx.start()
 
+    # Health + live-introspection server: /health readiness includes engine
+    # liveness (stall watchdog, compiles-after-warmup, last-step age) and
+    # /debug/state dumps the scheduler's live view (sequences, block pool,
+    # digests, step timeline).
+    status_server = None
+    if args.health_port is not None:
+        from dynamo_tpu.runtime.config import SystemConfig
+        from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer
+
+        health = SystemHealth()
+        health.set_system_ready()
+        if hasattr(engine, "watchdog"):
+            health.attach_engine(
+                lambda: {
+                    **engine.watchdog.to_stats(),
+                    "compiles_after_warmup_total":
+                        engine.scheduler.flight.compiles_after_warmup_total,
+                }
+            )
+        status_server = SystemStatusServer(
+            health,
+            config=SystemConfig(enabled=True, port=args.health_port, host="0.0.0.0"),
+            state_probe=getattr(engine, "debug_state", None),
+        )
+        await status_server.start()
+
     logger.info("worker ready: role=%s model=%s instance=%x", args.role, card.name, worker_id)
     try:
         await drt.runtime.cancellation.cancelled()
     finally:
+        if status_server is not None:
+            await status_server.stop()
         for pub in publishers:
             await pub.stop()
         if kvx is not None:

@@ -6,7 +6,12 @@ import json
 import os
 import re
 
-from dynamo_tpu.metrics_aggregator import COUNTER_KEYS, GAUGE_KEYS
+from dynamo_tpu.metrics_aggregator import (
+    COUNTER_KEYS,
+    DIGEST_KEYS,
+    FLEET_DIGEST_PREFIX,
+    GAUGE_KEYS,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -22,6 +27,11 @@ def _component_families():
         fams.add(f"dynamo_component_worker_{key}")
         if not key.endswith("_total"):
             fams.add(f"dynamo_component_worker_{key}_total")
+    # Fleet-merged digest re-exports (DigestCollector): native histogram +
+    # quantile-gauge families per digest stream.
+    for key in DIGEST_KEYS:
+        fams.add(f"{FLEET_DIGEST_PREFIX}{key}_seconds")
+        fams.add(f"{FLEET_DIGEST_PREFIX}{key}_seconds_quantile")
     return fams
 
 

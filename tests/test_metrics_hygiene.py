@@ -45,7 +45,12 @@ def parse_families(text: str):
 def frontend_registry() -> MetricsRegistry:
     """HttpService's registry with every metric factory touched (the way a
     live frontend would after serving traffic)."""
-    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    from dynamo_tpu.runtime.telemetry import SloConfig
+
+    service = HttpService(
+        ModelManager(), host="127.0.0.1", port=0,
+        slo=SloConfig(ttft_ms=100.0, tpot_ms=20.0),
+    )
     model = "hygiene-model"
     service._m_requests(model, "200").inc()
     service._m_inflight(model).set(1)
@@ -55,14 +60,29 @@ def frontend_registry() -> MetricsRegistry:
     service._m_queue(model).observe(0.02)
     service._m_output_tokens(model).inc(10)
     service._m_input_tokens(model).inc(20)
+    # SLA telemetry path: one attained + one violated request through the
+    # real recording helper (digest families + SLO/goodput counters/gauges).
+    import time
+
+    t0 = time.monotonic()
+    service._record_request_telemetry(model, t0 - 0.05, t0 - 0.04, t0, 8)
+    service._record_request_telemetry(model, t0 - 5.0, t0 - 0.1, t0, 8)
     return service.metrics
 
 
 def aggregator_registry() -> MetricsRegistry:
     """MetricsAggregator's registry fed one full scrape covering every
-    gauge and counter key a worker can report."""
+    gauge and counter key a worker can report, plus a digest payload so
+    the fleet digest re-export families render too."""
+    from dynamo_tpu.metrics_aggregator import DIGEST_KEYS
+    from dynamo_tpu.runtime.telemetry import Telemetry
+
+    telem = Telemetry()
+    for name in DIGEST_KEYS:
+        telem.observe(name, 0.1)
     agg = MetricsAggregator(drt=None, namespace="ns", component="backend", endpoint="generate")
-    stats = {0xA: {key: 1.0 for key in GAUGE_KEYS + COUNTER_KEYS}}
+    stats = {0xA: {**{key: 1.0 for key in GAUGE_KEYS + COUNTER_KEYS},
+                   "digests": telem.to_wire()}}
     agg.export_stats(stats)
     agg.export_stats(stats)  # second scrape exercises the delta path
     return agg.registry

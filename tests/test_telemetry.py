@@ -1,0 +1,656 @@
+"""SLA telemetry plane: digest correctness (relative-error + merge
+properties), windowed views, SLO/goodput accounting, the stall watchdog,
+fleet digest aggregation, the Prometheus parser against real render()
+output, and the end-to-end signal path frontend → worker → scheduler →
+aggregator → PrometheusObserver."""
+
+import asyncio
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.metrics_aggregator import DIGEST_KEYS, MetricsAggregator
+from dynamo_tpu.planner.observer import (
+    PrometheusObserver,
+    parse_prometheus,
+    parse_prometheus_samples,
+)
+from dynamo_tpu.runtime.telemetry import (
+    DigestCollector,
+    LatencyDigest,
+    SloConfig,
+    SloJudge,
+    StallWatchdog,
+    Telemetry,
+    WindowedDigest,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RE = 0.01
+
+
+def exact_quantile(values, q):
+    vs = sorted(values)
+    return vs[int(q * (len(vs) - 1))]
+
+
+# --- digest correctness ------------------------------------------------------
+
+STREAMS = {
+    "lognormal": lambda rng: [rng.lognormvariate(0, 2) for _ in range(20000)],
+    "uniform": lambda rng: [rng.uniform(1e-4, 10.0) for _ in range(20000)],
+    # Adversarial: sorted ramp (every bucket in order), constant stream
+    # (single bucket), nine decades of dynamic range, zeros mixed in.
+    "sorted_ramp": lambda rng: [i / 1000.0 + 1e-6 for i in range(20000)],
+    "constant": lambda rng: [0.25] * 5000,
+    "nine_decades": lambda rng: [10 ** rng.uniform(-6, 3) for _ in range(20000)],
+    "with_zeros": lambda rng: [0.0] * 500 + [rng.uniform(0.001, 1.0) for _ in range(5000)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(STREAMS))
+def test_digest_quantiles_within_relative_error(name):
+    rng = random.Random(1234)
+    values = STREAMS[name](rng)
+    d = LatencyDigest(relative_error=RE)
+    for v in values:
+        d.observe(v)
+    assert d.count == len(values)
+    for q in (0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+        est = d.quantile(q)
+        exact = exact_quantile(values, q)
+        if exact <= 1e-9:
+            assert est == 0.0
+            continue
+        # DDSketch guarantee: the estimate is within the relative error of
+        # a true sample value at (or adjacent to) the rank — allow 2α for
+        # the rank-interpolation edge.
+        assert abs(est - exact) <= 2 * RE * exact + 1e-12, (q, est, exact)
+
+
+def test_digest_merge_equals_single_stream():
+    rng = random.Random(7)
+    values = [rng.lognormvariate(-2, 3) for _ in range(30000)]
+    single = LatencyDigest(RE)
+    parts = [LatencyDigest(RE) for _ in range(4)]
+    for i, v in enumerate(values):
+        single.observe(v)
+        parts[i % 4].observe(v)
+    merged = parts[0]
+    for p in parts[1:]:
+        merged.merge(p)
+    assert merged.buckets == single.buckets
+    assert merged.count == single.count and merged.zero_count == single.zero_count
+    assert math.isclose(merged.sum, single.sum, rel_tol=1e-9)
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == single.quantile(q)
+
+
+def test_digest_merge_rejects_mismatched_error():
+    with pytest.raises(ValueError, match="relative error"):
+        LatencyDigest(0.01).merge(LatencyDigest(0.02))
+
+
+def test_digest_wire_roundtrip_through_json():
+    d = LatencyDigest(RE)
+    for v in (0.0, 1e-5, 0.01, 0.5, 3.0, 3.0, 120.0):
+        d.observe(v)
+    # JSON stringifies int bucket keys — from_wire must accept both forms.
+    wire = json.loads(json.dumps(d.to_wire()))
+    back = LatencyDigest.from_wire(wire)
+    assert back.buckets == d.buckets
+    assert back.count == d.count and back.zero_count == d.zero_count
+    for q in (0.5, 0.99):
+        assert back.quantile(q) == d.quantile(q)
+
+
+def test_windowed_digest_rotation_with_fake_clock():
+    clock = [0.0]
+    wd = WindowedDigest(RE, window_s=6.0, slices=3, clock=lambda: clock[0])
+    wd.observe(1.0)
+    assert wd.snapshot().count == 1 and wd.total.count == 1
+    clock[0] = 4.0  # two slices later: sample still in window
+    assert wd.snapshot().count == 1
+    clock[0] = 100.0  # far past the window
+    assert wd.snapshot().count == 0
+    assert wd.total.count == 1  # cumulative never forgets
+    wd.observe(2.0)
+    assert wd.snapshot().count == 1 and wd.total.count == 2
+
+
+# --- SLO / goodput / watchdog ------------------------------------------------
+
+def test_slo_judge_counters_and_goodput():
+    clock = [0.0]
+    judge = SloJudge(SloConfig(ttft_ms=100.0, tpot_ms=10.0),
+                     clock=lambda: clock[0], rate_window_s=30.0)
+    assert judge.judge(0.05, 0.005, 100)  # both attained
+    clock[0] = 1.0
+    assert not judge.judge(0.5, 0.005, 50)  # ttft violated
+    clock[0] = 2.0
+    assert not judge.judge(0.05, 0.5, 50)  # tpot violated
+    clock[0] = 3.0
+    assert judge.judge(0.01, None, 1)  # single-token: tpot unjudged
+    assert judge.attained == {"ttft": 3, "tpot": 2}
+    assert judge.violated == {"ttft": 1, "tpot": 1}
+    assert judge.goodput_requests_total == 2
+    assert judge.goodput_tokens_total == 101
+    assert math.isclose(judge.attainment(), 5 / 7)
+    req_s, tok_s = judge.goodput_rates()
+    assert req_s > 0 and tok_s > 0
+    stats = judge.to_stats()
+    assert stats["slo_ttft_attained_total"] == 3
+    assert stats["goodput_tokens_total"] == 101
+    # Window expiry: far future → rates drain to zero.
+    clock[0] = 1000.0
+    assert judge.goodput_rates() == (0.0, 0.0)
+
+
+def test_slo_judge_disabled_counts_nothing():
+    judge = SloJudge(SloConfig())
+    assert judge.judge(99.0, 99.0, 5)
+    assert judge.requests_total == 0 and judge.attainment() == 1.0
+
+
+def test_stall_watchdog_monkeypatched_clock():
+    clock = [0.0]
+    state = {"has_work": False, "last_step": None}
+    wd = StallWatchdog(
+        probe=lambda: (state["has_work"], state["last_step"]),
+        stall_after_s=30.0, clock=lambda: clock[0],
+    )
+    assert not wd.check()
+    # Idle engine far past the threshold: not stalled (no work queued).
+    clock[0] = 1000.0
+    assert not wd.check() and wd.stalls_total == 0
+    # Work queued, steps advancing: healthy.
+    state["has_work"] = True
+    state["last_step"] = 995.0
+    assert not wd.check()
+    # Steps stop while work is queued: stalled exactly once past threshold.
+    clock[0] = 1026.0  # 31s after last step
+    assert wd.check() and wd.stalled
+    assert wd.stalls_total == 1
+    assert wd.check() and wd.stalls_total == 1  # no re-fire while stalled
+    stats = wd.to_stats()
+    assert stats["engine_stalled"] == 1.0 and stats["last_step_age_s"] == 31.0
+    # Step loop recovers: stall clears; a second wedge fires again.
+    state["last_step"] = 1025.0
+    assert not wd.check()
+    clock[0] = 1100.0
+    assert wd.check() and wd.stalls_total == 2
+
+
+# --- fleet aggregation --------------------------------------------------------
+
+def test_aggregator_merges_worker_digests_into_fleet_quantiles():
+    t_a, t_b = Telemetry(), Telemetry()
+    for _ in range(1000):
+        t_a.observe("ttft", 0.1)
+        t_b.observe("ttft", 0.4)
+    agg = MetricsAggregator(drt=None, namespace="ns", component="backend",
+                            endpoint="generate")
+    stats = {1: {"digests": t_a.to_wire()}, 2: {"digests": t_b.to_wire()}}
+    agg.export_stats(stats)
+    text = agg.registry.render().decode()
+
+    samples = parse_prometheus_samples(text)
+    by = {(s.name, s.labels.get("quantile")): s.value for s in samples}
+    p50 = by[("dynamo_component_fleet_ttft_seconds_quantile", "0.5")]
+    p99 = by[("dynamo_component_fleet_ttft_seconds_quantile", "0.99")]
+    # Fleet p50 must reflect worker A's half (0.1) and p99 worker B's (0.4)
+    # — the property averaging per-worker quantiles destroys.
+    assert abs(p50 - 0.1) <= 2 * RE * 0.1
+    assert abs(p99 - 0.4) <= 2 * RE * 0.4
+    # Native histogram: cumulative counts + conservation of mass.
+    count = next(s.value for s in samples
+                 if s.name == "dynamo_component_fleet_ttft_seconds_count")
+    assert count == 2000
+    inf = next(s.value for s in samples
+               if s.name == "dynamo_component_fleet_ttft_seconds_bucket"
+               and s.labels.get("le") == "+Inf")
+    assert inf == 2000
+    # Re-export is idempotent across scrapes (cumulative, not re-added).
+    agg.export_stats(stats)
+    text2 = agg.registry.render().decode()
+    count2 = next(s.value for s in parse_prometheus_samples(text2)
+                  if s.name == "dynamo_component_fleet_ttft_seconds_count")
+    assert count2 == 2000
+
+
+def test_digest_collector_histogram_buckets_monotone():
+    t = Telemetry()
+    rng = random.Random(3)
+    for _ in range(5000):
+        t.observe("itl", rng.lognormvariate(-5, 2))
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    reg = CollectorRegistry()
+    dc = DigestCollector("dynamo_component_fleet_", registry=reg)
+    dc.update_from_wire([t.to_wire()])
+    text = generate_latest(reg).decode()
+    buckets = [
+        (s.labels["le"], s.value) for s in parse_prometheus_samples(text)
+        if s.name == "dynamo_component_fleet_itl_seconds_bucket"
+    ]
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals), "histogram buckets must be cumulative"
+    assert vals[-1] == 5000
+
+
+# --- prometheus parsing (satellite: real render() output) --------------------
+
+def real_render_text() -> str:
+    from dynamo_tpu.runtime.metrics import MetricsRegistry, TTFT_BUCKETS
+
+    reg = MetricsRegistry(labels={"namespace": "ns"})
+    reg.counter("requests_total", "req", model="m", status="200").inc(5)
+    reg.counter("requests_total", "req", model="m", status="400").inc(2)
+    reg.gauge("kv_usage", "usage", worker="a").set(0.25)
+    reg.gauge("kv_usage", "usage", worker="b").set(0.75)
+    h = reg.histogram("ttft_seconds_hist", "ttft", buckets=TTFT_BUCKETS, model="m")
+    h.observe(0.1)
+    h.observe(0.3)
+    return reg.render().decode()
+
+
+def test_parse_prometheus_labeled_and_histogram_families():
+    text = real_render_text()
+    out = parse_prometheus(text)
+    # Labeled counter series sum across label sets.
+    assert out["dynamo_component_requests_total"] == 7
+    assert out["dynamo_component_kv_usage"] == 1.0
+    # Histogram children are parsed, not dropped.
+    assert out["dynamo_component_ttft_seconds_hist_count"] == 2
+    assert math.isclose(out["dynamo_component_ttft_seconds_hist_sum"], 0.4)
+    samples = parse_prometheus_samples(text)
+    le_inf = [s for s in samples
+              if s.name == "dynamo_component_ttft_seconds_hist_bucket"
+              and s.labels.get("le") == "+Inf"]
+    assert le_inf and le_inf[0].value == 2
+    # Label values survive with their metadata.
+    workers = {s.labels["worker"]: s.value for s in samples
+               if s.name == "dynamo_component_kv_usage"}
+    assert workers == {"a": 0.25, "b": 0.75}
+
+
+def test_parse_prometheus_edge_values():
+    text = (
+        'thing_total{label="va\\"lue"} 1e+05\n'
+        "bad_gauge NaN\n"
+        "inf_bucket{le=\"+Inf\"} +Inf\n"
+        "plain 3\n"
+    )
+    out = parse_prometheus(text)
+    assert out["thing_total"] == 1e5
+    assert "bad_gauge" not in out  # NaN must not poison sums
+    assert out["plain"] == 3
+    samples = parse_prometheus_samples(text)
+    assert any(s.labels.get("label") == 'va"lue' for s in samples)
+
+
+def test_observer_derives_load_from_two_scrapes():
+    obs = PrometheusObserver("http://unused/metrics")
+    scrape1 = (
+        "dynamo_frontend_requests_total 10\n"
+        "dynamo_frontend_input_tokens_total 1000\n"
+        "dynamo_frontend_output_tokens_total 500\n"
+        "dynamo_component_worker_slo_ttft_attained_total 8\n"
+        "dynamo_component_worker_slo_ttft_violated_total 2\n"
+        "dynamo_component_worker_goodput_requests_total 8\n"
+        "dynamo_component_worker_goodput_tokens_total 400\n"
+        'dynamo_component_fleet_ttft_seconds_quantile{quantile="0.5"} 0.05\n'
+        'dynamo_component_fleet_ttft_seconds_quantile{quantile="0.9"} 0.2\n'
+        'dynamo_component_fleet_ttft_seconds_quantile{quantile="0.99"} 0.4\n'
+        'dynamo_component_fleet_tpot_seconds_quantile{quantile="0.99"} 0.02\n'
+        'dynamo_component_fleet_queue_wait_seconds_quantile{quantile="0.99"} 0.1\n'
+        'dynamo_component_worker_kv_usage{worker="a"} 0.3\n'
+        'dynamo_component_worker_kv_usage{worker="b"} 0.5\n'
+    )
+    scrape2 = scrape1.replace(
+        "dynamo_frontend_requests_total 10", "dynamo_frontend_requests_total 20"
+    ).replace(
+        "dynamo_frontend_input_tokens_total 1000", "dynamo_frontend_input_tokens_total 3000"
+    ).replace(
+        "dynamo_frontend_output_tokens_total 500", "dynamo_frontend_output_tokens_total 1500"
+    ).replace(
+        "dynamo_component_worker_slo_ttft_attained_total 8",
+        "dynamo_component_worker_slo_ttft_attained_total 11",
+    ).replace(
+        "dynamo_component_worker_slo_ttft_violated_total 2",
+        "dynamo_component_worker_slo_ttft_violated_total 3",
+    ).replace(
+        "dynamo_component_worker_goodput_requests_total 8",
+        "dynamo_component_worker_goodput_requests_total 13",
+    )
+    obs.load_from_text(scrape1, now=0.0)
+    load = obs.load_from_text(scrape2, now=10.0)
+    assert math.isclose(load.request_rate, 1.0)
+    assert math.isclose(load.avg_isl, 200.0)
+    assert math.isclose(load.avg_osl, 100.0)
+    assert load.ttft_p50 == 0.05 and load.ttft_p90 == 0.2 and load.ttft_p99 == 0.4
+    assert load.tpot_p99 == 0.02 and load.queue_wait_p99 == 0.1
+    assert math.isclose(load.slo_attainment, 3 / 4)  # window deltas, not totals
+    assert math.isclose(load.goodput_req_s, 0.5)
+    assert math.isclose(load.kv_util, 0.4)
+
+
+# --- engine + mocker stats surface -------------------------------------------
+
+def tiny_engine(**sched_kw):
+    from dynamo_tpu.engine.engine import EngineArgs, TpuEngine
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+
+    return TpuEngine.build(
+        EngineArgs(
+            model="tiny", dtype="float32", eos_token_ids=[0],
+            scheduler=SchedulerConfig(
+                num_blocks=64, prefill_buckets=[16, 32, 64], decode_buckets=[1, 2, 4],
+                **sched_kw,
+            ),
+        )
+    )
+
+
+async def test_engine_stats_expose_telemetry_plane():
+    from dynamo_tpu.runtime.engine import Context
+
+    engine = tiny_engine(slo_ttft_ms=60000.0, slo_tpot_ms=60000.0)
+    try:
+        for start in (0, 40):
+            req = {"token_ids": list(range(start, start + 20)),
+                   "sampling_options": {"temperature": 0},
+                   "stop_conditions": {"max_tokens": 4}}
+            async for _ in engine.generate(req, Context()):
+                pass
+        stats = engine.stats_handler()
+        for key in ("digests", "slo_ttft_attained_total", "goodput_requests_total",
+                    "kv_free_blocks", "kv_cached_blocks", "kv_fragmentation",
+                    "prefix_hit_rate", "engine_stalled", "engine_stalls_total",
+                    "last_step_age_s", "slo_attainment",
+                    "step_decode_flops_total", "step_decode_bytes_total",
+                    "mfu_decode", "hbm_frac_decode"):
+            assert key in stats, key
+        assert stats["digests"]["ttft"]["total"]["count"] == 2
+        assert stats["digests"]["itl"]["total"]["count"] > 0
+        assert stats["slo_ttft_attained_total"] == 2
+        assert stats["goodput_requests_total"] == 2
+        assert stats["engine_stalled"] == 0.0
+        json.dumps(stats)  # the scrape payload must stay wire-serializable
+
+        state = engine.debug_state()
+        assert state["block_pool"]["total"] == 64
+        assert state["flight"]["recent_steps"], "step timeline empty"
+        assert "ttft" in state["digests"]
+        assert state["watchdog"]["stall_after_s"] > 0
+    finally:
+        await engine.stop()
+
+
+async def test_health_server_reports_stalled_engine_notready():
+    """Satellite: /health readiness gains engine liveness — a stalled
+    engine reports notready (monkeypatched clock), /debug/state dumps the
+    live scheduler view, /debug/stacks answers."""
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import StopConditions
+    from dynamo_tpu.runtime.config import SystemConfig
+    from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer
+
+    engine = tiny_engine(stall_after_s=30.0)
+    health = SystemHealth()
+    health.set_system_ready()
+    health.attach_engine(
+        lambda: {
+            **engine.watchdog.to_stats(),
+            "compiles_after_warmup_total":
+                engine.scheduler.flight.compiles_after_warmup_total,
+        }
+    )
+    server = SystemStatusServer(
+        health, config=SystemConfig(enabled=True, port=0, host="127.0.0.1"),
+        state_probe=engine.debug_state,
+    )
+    await server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(base + "/health") as r:
+                body = await r.json()
+                assert r.status == 200 and body["status"] == "ready"
+                assert "engine" in body and "last_step_age_s" in body["engine"]
+                assert "compiles_after_warmup_total" in body["engine"]
+
+            # Queue work WITHOUT stepping (no engine loop is running), then
+            # advance the watchdog's clock past the threshold: stalled.
+            engine.scheduler.add_request(
+                "stuck", list(range(8)), SamplingParams(temperature=0.0),
+                StopConditions(max_tokens=2),
+            )
+            t0 = engine.watchdog._start_ts
+            engine.watchdog._clock = lambda: t0 + 1000.0
+            async with s.get(base + "/health") as r:
+                body = await r.json()
+                assert r.status == 503 and body["status"] == "notready"
+                assert body["engine"]["engine_stalled"] == 1.0
+            assert engine.watchdog.stalls_total == 1
+
+            async with s.get(base + "/debug/state") as r:
+                assert r.status == 200
+                state = await r.json()
+                assert state["waiting"][0]["request_id"] == "stuck"
+                assert "block_pool" in state and "digests" in state
+
+            async with s.get(base + "/debug/stacks") as r:
+                assert r.status == 200
+                stacks = await r.json()
+                assert any("MainThread" in k for k in stacks)
+    finally:
+        await server.stop()
+        engine.scheduler.abort("stuck")
+
+
+async def test_mocker_emits_same_telemetry_stats():
+    """Satellite: the mocker's stats path carries the same digest/SLO keys
+    as the real engine, so planner stacks run engine-free."""
+    from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+    from dynamo_tpu.runtime.engine import Context
+
+    mock = MockTpuEngine(MockEngineArgs(
+        speedup_ratio=100.0, slo_ttft_ms=60000.0, slo_tpot_ms=0.000001,
+    ))
+
+    async def run(tokens):
+        async for _ in mock.generate(
+            {"token_ids": tokens, "stop_conditions": {"max_tokens": 8}}, Context()
+        ):
+            pass
+
+    await asyncio.gather(*(run(list(range(1, 20 + i))) for i in range(4)))
+    stats = mock.stats_handler()
+    for key in ("digests", "slo_ttft_attained_total", "slo_tpot_violated_total",
+                "goodput_requests_total", "slo_attainment",
+                "kv_free_blocks", "prefix_hit_rate"):
+        assert key in stats, key
+    assert stats["digests"]["ttft"]["total"]["count"] == 4
+    assert stats["digests"]["tpot"]["total"]["count"] == 4
+    assert stats["slo_ttft_attained_total"] == 4
+    assert stats["slo_tpot_violated_total"] == 4  # impossible 1ns TPOT target
+    assert stats["goodput_requests_total"] == 0  # tpot violations kill goodput
+    assert 0.0 < stats["slo_attainment"] < 1.0
+
+    # The aggregator consumes the mocker scrape exactly like an engine's.
+    agg = MetricsAggregator(drt=None, namespace="ns", component="mock",
+                            endpoint="generate")
+    agg.export_stats({7: stats})
+    text = agg.registry.render().decode()
+    assert "dynamo_component_fleet_ttft_seconds_quantile" in text
+    assert "dynamo_component_worker_slo_ttft_attained_total" in text
+
+
+# --- trace_view --summary (satellite) ----------------------------------------
+
+def test_trace_view_summary_tolerates_truncated_file(tmp_path):
+    path = tmp_path / "crash.jsonl"
+    records = [
+        {"kind": "span", "name": "http_request", "trace_id": "t1", "span_id": "s1",
+         "ts": 1.0, "dur_s": 0.5, "service": "frontend"},
+        {"kind": "event", "name": "admitted", "trace_id": "t1", "ts": 1.1,
+         "service": "scheduler", "attrs": {"queue_s": 0.02}},
+        {"kind": "event", "name": "first_token", "trace_id": "t1", "ts": 1.2,
+         "service": "scheduler", "attrs": {"ttft_s": 0.12}},
+        {"kind": "event", "name": "prefill_chunk", "trace_id": "t1", "ts": 1.15,
+         "service": "scheduler", "attrs": {"dur_s": 0.03, "tokens": 64}},
+        # ts-less fragment (partial serialization before a crash).
+        {"kind": "event", "name": "finish", "trace_id": "t1"},
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        # Crash-time truncation: the final line is cut mid-record.
+        f.write('{"kind": "span", "name": "worker_handle", "trace_id": "t1", "ts"')
+
+    tool = os.path.join(REPO, "tools", "trace_view.py")
+    # --summary prints per-phase digest percentiles.
+    proc = subprocess.run([sys.executable, tool, str(path), "--summary"],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    for needle in ("ttft", "queue_wait", "prefill_chunk", "span:http_request", "p99"):
+        assert needle in proc.stdout, proc.stdout
+    # 120 ms ttft renders in the table.
+    ttft_line = next(l for l in proc.stdout.splitlines() if l.startswith("ttft"))
+    assert "120.0" in ttft_line or "119." in ttft_line, ttft_line
+    # The timeline modes tolerate the same file.
+    for argv in ([str(path)], [str(path), "--all"]):
+        proc = subprocess.run([sys.executable, tool, *argv],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+
+
+# --- end-to-end signal path ---------------------------------------------------
+
+async def test_e2e_signal_path_frontend_to_observer():
+    """Acceptance: traffic through frontend → worker → scheduler produces
+    non-trivial ttft_p99 / slo_attainment / kv_util in
+    PrometheusObserver.observe(), consistent with the per-request values
+    the test measured itself."""
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.entrypoint import build_routed_pipeline, register_llm
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.runtime.config import SystemConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer
+    from dynamo_tpu.runtime.push_router import PushRouter
+
+    MODEL = "tiny-sla"
+    drt = await DistributedRuntime.detached()
+    # Engine SLO: generous TTFT (always attained on CPU) + impossible TPOT
+    # (always violated) → attainment is a KNOWN 0.5 from the engine side.
+    engine = tiny_engine(slo_ttft_ms=60000.0, slo_tpot_ms=0.000001)
+    service = agg_server = None
+    try:
+        ep = drt.namespace("slatest").component("backend").endpoint("generate")
+        card = ModelDeploymentCard(name=MODEL, model_type="chat")
+        handle, _ = await register_llm(drt, ep, engine, card,
+                                       stats_handler=engine.stats_handler)
+        worker_id = handle.instance.instance_id
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+
+        manager = ModelManager()
+        pipeline = build_routed_pipeline(ByteTokenizer(), PushRouter(client), card)
+        manager.add_model("chat", MODEL, pipeline)
+        service = HttpService(manager, host="127.0.0.1", port=0)
+        await service.start()
+
+        # Aggregator fed from the REAL stats scrape wire (msgpack round
+        # trip), served on its own /metrics like production.
+        agg = MetricsAggregator(drt, "slatest", "backend", "generate")
+        agg_health = SystemHealth()
+        agg_health.set_system_ready()
+        agg_server = SystemStatusServer(
+            agg_health, metrics=agg.registry,
+            config=SystemConfig(enabled=True, port=0, host="127.0.0.1"),
+        )
+        await agg_server.start()
+
+        fe_url = f"http://127.0.0.1:{service.port}/metrics"
+        agg_url = f"http://127.0.0.1:{agg_server.port}/metrics"
+        observer = PrometheusObserver(fe_url, extra_urls=[agg_url])
+
+        async def scrape_to_agg():
+            agg.export_stats(await client.scrape_stats())
+
+        await scrape_to_agg()
+        await observer.observe()  # baseline window
+
+        # Drive traffic, measuring client-side per-request TTFT ourselves.
+        # Completions streaming: unlike chat (which emits an instant role
+        # preamble), a completion chunk only appears once a real token
+        # decoded — so first-data-line time IS the client-observed TTFT.
+        client_ttfts = []
+        async with aiohttp.ClientSession() as s:
+            for i in range(6):
+                body = {"model": MODEL, "prompt": f"req {i} " + "x" * i,
+                        "max_tokens": 6, "temperature": 0, "stream": True}
+                t0 = time.monotonic()
+                first_at = None
+                async with s.post(f"http://127.0.0.1:{service.port}/v1/completions",
+                                  json=body) as r:
+                    assert r.status == 200
+                    async for raw in r.content:
+                        if raw.startswith(b"data: ") and b"[DONE]" not in raw and first_at is None:
+                            first_at = time.monotonic()
+                assert first_at is not None
+                client_ttfts.append(first_at - t0)
+
+        await scrape_to_agg()
+        load = await observer.observe()
+
+        # Request-shape deltas came through the frontend counters.
+        assert load.request_rate > 0
+        assert load.avg_osl > 0
+
+        # Quantiles: non-trivial and consistent with what the client saw —
+        # engine-internal TTFT can't exceed the worst client-observed TTFT
+        # (which includes tokenize/route/detok), and a p99 of positives is
+        # positive.
+        assert load.ttft_p50 > 0 and load.ttft_p99 > 0
+        assert load.ttft_p50 <= load.ttft_p99
+        assert load.ttft_p99 <= max(client_ttfts) * (1 + 2 * RE) + 0.005, (
+            load.ttft_p99, max(client_ttfts))
+        assert load.tpot_p99 > 0  # engine decoded multiple tokens per request
+
+        # SLO attainment: engine judged ttft attained + tpot violated for
+        # every request → exactly half the engine's phase checks attained.
+        stats = engine.stats_handler()
+        assert stats["slo_ttft_attained_total"] == 6
+        assert stats["slo_tpot_violated_total"] == 6
+        assert 0.0 < load.slo_attainment < 1.0
+        assert math.isclose(load.slo_attainment, 0.5, abs_tol=1e-6)
+        assert load.goodput_req_s == 0.0  # nothing attained BOTH targets
+
+        # KV utilization: prefix caching keeps blocks resident, so the
+        # worker's kv_usage gauge is live and non-zero after traffic.
+        assert load.kv_util > 0
+
+        # The same worker id labels the per-worker series on the aggregator.
+        async with aiohttp.ClientSession() as s:
+            async with s.get(agg_url) as r:
+                text = await r.text()
+        assert f'worker="{worker_id:x}"' in text
+    finally:
+        if service is not None:
+            await service.stop()
+        if agg_server is not None:
+            await agg_server.stop()
+        await engine.stop()
+        await drt.shutdown()

@@ -11,10 +11,16 @@ Usage::
     python tools/trace_view.py trace.jsonl                 # list traces
     python tools/trace_view.py trace.jsonl -t <trace_id>   # one timeline
     python tools/trace_view.py trace.jsonl --all           # every timeline
+    python tools/trace_view.py trace.jsonl --summary       # digest percentiles
     python tools/trace_view.py trace.jsonl --chrome out.json
 
 Multiple input files merge (frontend + worker processes each write their
 own file; records carry the trace id, so merging is a concat).
+
+Crash-time flight recordings are first-class input: a process dying
+mid-write leaves a truncated final line (and possibly records missing
+fields) — malformed lines are skipped and incomplete records ignored
+rather than poisoning the whole file.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from collections import defaultdict
 from typing import Dict, List
 
 from dynamo_tpu.runtime.tracing import chrome_trace, read_trace_file
+from dynamo_tpu.runtime.telemetry import LatencyDigest
 
 BAR_WIDTH = 40
 
@@ -33,11 +40,63 @@ BAR_WIDTH = 40
 def group_by_trace(records: List[dict]) -> Dict[str, List[dict]]:
     traces: Dict[str, List[dict]] = defaultdict(list)
     for rec in records:
-        if rec.get("kind") in ("span", "event") and rec.get("trace_id"):
+        # Records must carry a timestamp to be placeable on a timeline;
+        # a crash mid-serialization can leave ts-less fragments.
+        if (
+            rec.get("kind") in ("span", "event")
+            and rec.get("trace_id")
+            and isinstance(rec.get("ts"), (int, float))
+        ):
             traces[rec["trace_id"]].append(rec)
     for recs in traces.values():
         recs.sort(key=lambda r: r.get("ts") or 0.0)
     return traces
+
+
+# --summary: which record fields carry a duration/latency, keyed by the
+# phase name the digest reports under. Spans contribute their dur_s under
+# the span name; events map their latency attribute explicitly.
+_EVENT_LATENCY_ATTRS = {
+    "prefill_chunk": ("prefill_chunk", "dur_s"),
+    "mixed_ride": ("mixed_ride", "dur_s"),
+    "first_token": ("ttft", "ttft_s"),
+    "admitted": ("queue_wait", "queue_s"),
+}
+
+
+def summarize(records: List[dict], out=sys.stdout) -> None:
+    """Per-phase digest percentiles over every record in the files: span
+    durations by span name plus the scheduler's latency-bearing lifecycle
+    events (ttft, queue_wait, chunk/ride durations)."""
+    digests: Dict[str, LatencyDigest] = {}
+
+    def observe(key: str, value) -> None:
+        if not isinstance(value, (int, float)) or value < 0:
+            return
+        digests.setdefault(key, LatencyDigest()).observe(float(value))
+
+    for rec in records:
+        kind = rec.get("kind")
+        name = rec.get("name") or "?"
+        if kind == "span":
+            observe(f"span:{name}", rec.get("dur_s"))
+        elif kind == "event":
+            mapped = _EVENT_LATENCY_ATTRS.get(name)
+            if mapped is not None:
+                key, attr = mapped
+                observe(key, (rec.get("attrs") or {}).get(attr))
+    if not digests:
+        out.write("no latency-bearing records found\n")
+        return
+    out.write(f"{'phase':<20} {'count':>7} {'p50 ms':>10} {'p90 ms':>10} "
+              f"{'p99 ms':>10} {'max ms':>10}\n")
+    for key in sorted(digests):
+        d = digests[key]
+        p50, p90, p99 = d.percentiles((0.5, 0.9, 0.99))
+        out.write(
+            f"{key:<20} {d.count:>7} {1000 * p50:>10.2f} {1000 * p90:>10.2f} "
+            f"{1000 * p99:>10.2f} {1000 * d.max:>10.2f}\n"
+        )
 
 
 def trace_summary(trace_id: str, recs: List[dict]) -> str:
@@ -78,6 +137,8 @@ def main() -> int:
     p.add_argument("files", nargs="+", help="JSONL trace files (merged)")
     p.add_argument("-t", "--trace-id", default=None, help="render one trace's timeline")
     p.add_argument("--all", action="store_true", help="render every trace's timeline")
+    p.add_argument("--summary", action="store_true",
+                   help="per-phase digest percentiles across all traces")
     p.add_argument("--chrome", default=None, metavar="OUT",
                    help="write a Chrome-trace/Perfetto JSON file")
     args = p.parse_args()
@@ -85,6 +146,11 @@ def main() -> int:
     records: List[dict] = []
     for path in args.files:
         records.extend(read_trace_file(path))
+
+    if args.summary:
+        summarize(records)
+        return 0
+
     traces = group_by_trace(records)
     if not traces:
         print("no trace records found", file=sys.stderr)
