@@ -1,6 +1,8 @@
-"""Attention implementations: the Pallas flash prefill kernel (prefill.py —
-40.8 TF/s causal at 1B shapes on v5e), ring attention for sequence/context
-parallelism (ring.py), and the XLA width-bucketed gather for paged decode
-(models/llama.py). A Pallas paged-DMA decode kernel lived here until r4;
-it was deleted after measuring 3-6× slower than the gather in every regime
-— ModelConfig.attention_impl records the numbers."""
+"""Attention implementations: the ragged paged-attention megakernel
+(megakernel.py — ONE Pallas launch per layer for a whole mixed step's
+ragged batch, plus the fused N-step decode window; TPU auto-selection),
+the Pallas flash prefill kernel (prefill.py — 40.8 TF/s causal at 1B
+shapes on v5e), the opt-in per-piece paged decode kernel (decode.py),
+ring attention for sequence/context parallelism (ring.py), and the XLA
+width-bucketed gather fallback (models/llama.py). Selection + the full
+dispatch-overhead record: ModelConfig.attention_impl."""

@@ -62,25 +62,42 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
-    # Decode attention implementation for the cached-prefix piece — in
-    # plain decode steps AND the decode rows of MIXED prefill+decode steps
-    # (scheduler mixed batching: each engine step carries the full decode
-    # batch plus up to SchedulerConfig.mixed_prefill_budget prefill-chunk
-    # tokens as one ragged batch — per-sequence (start, len) rows over the
-    # paged cache, decode entries are length-1 rows; llama.mixed_step).
-    # "auto" == "gather": XLA width-bucketed gather, two-piece online-
-    # softmax merge, once-per-window hoist (decode_multi). "paged" opts in
-    # to the Pallas paged flash-decode kernel (attention/decode.py) —
-    # correct (interpret-mode parity tests) but NOT auto-selected: on this
-    # tunneled v5e runtime every pallas_call execution carries ms-scale
-    # dispatch overhead (a no-op kernel inside a jitted loop measures
-    # 1.3-5 ms/call; 16 per-layer calls/step is fatal), so the kernel
-    # loses to the gather end-to-end regardless of its memory-traffic win.
-    # Opt in only on a direct-attached TPU at long contexts, where the
-    # once-per-page HBM read beats the gather's triple traffic and the
-    # dispatch tax is gone. The r4 kernel was deleted for a different
-    # reason (per-page DMA issue cost at 16-token pages); both records
-    # matter if this is revisited.
+    # Attention implementation for the paged-prefix piece — in plain
+    # decode steps, the decode rows AND chunk rows of MIXED prefill+decode
+    # steps, and prefill chunks (llama.mixed_step / prefill /
+    # decode_layer_scan):
+    # - "gather": XLA width-bucketed gather, two-piece online-softmax
+    #   merge, once-per-window hoist (decode_multi). The CPU/debug
+    #   baseline, and the off-TPU resolution of "auto".
+    # - "megakernel": the ragged paged-attention megakernel
+    #   (attention/megakernel.py) — ONE pallas_call per layer serves the
+    #   whole step's ragged batch ((start, len) chunk rows + length-1
+    #   decode rows share one grid), scalar-prefetched block tables,
+    #   block-diagonal GQA fold, pl.when-skipped dead slots, and an int8-KV
+    #   dequant-in-VMEM path. Amortizes the dispatch overhead that killed
+    #   the r4/r5 per-piece kernels: 1 launch/layer/step regardless of
+    #   batch composition (vs 2+ for chunk+decode kernels), and
+    #   decode_multi_fused collapses a whole greedy decode window into ONE
+    #   launch (grid = steps × layers, on-chip token feedback) where the
+    #   working set fits VMEM (megakernel.fused_window_fits).
+    # - "paged": the r5 per-piece Pallas paged flash-decode kernel
+    #   (attention/decode.py) — correct (interpret-mode parity tests) but
+    #   NEVER auto-selected: on tunneled runtimes every pallas_call
+    #   execution carries ms-scale dispatch overhead (a no-op kernel
+    #   inside a jitted loop measures 1.3-5 ms/call; 16 per-layer
+    #   calls/step is fatal), so it lost every serving regime to the
+    #   gather end-to-end regardless of its memory-traffic win. No int8
+    #   path — int8 caches degrade to gather with a logged warning
+    #   (llama.resolve_attention_impl).
+    # - "auto": "megakernel" on TPU, "gather" elsewhere (interpreted
+    #   Pallas is test-only). Measured record: decode at b32 sat at ~54%
+    #   of HBM roofline on the gather (BENCH_r05 — the gather's
+    #   read + packed-copy write + attend re-read is 3× the true KV
+    #   bytes); the megakernel streams each page HBM→VMEM exactly once
+    #   per launch and pays dispatch once per layer, not per piece. Track
+    #   via bench.py's `decode_attention` section (tok/s,
+    #   pct_hbm_roofline, per-launch dispatch overhead, gather vs
+    #   megakernel at b∈{8,32}).
     attention_impl: str = "auto"
     # Prefill chunk attention — for phase-separated prefills AND the
     # ragged chunk rows of mixed steps (attention/ragged.py): "auto" =
@@ -107,15 +124,15 @@ class ModelConfig:
     weight_dtype: str = "auto"
 
     def __post_init__(self):
-        if self.attention_impl not in ("auto", "gather", "paged"):
+        if self.attention_impl not in ("auto", "gather", "paged", "megakernel"):
             raise ValueError(
-                f"attention_impl must be auto|gather|paged, got {self.attention_impl!r}"
+                "attention_impl must be auto|gather|paged|megakernel, "
+                f"got {self.attention_impl!r}"
             )
-        if self.attention_impl == "paged" and self.kv_cache_dtype == "int8":
-            raise ValueError(
-                "attention_impl='paged' has no int8-KV path — use 'gather' "
-                "(the only int8 decode backend) or bf16 KV"
-            )
+        # attention_impl='paged' + int8 KV no longer raises: the paged
+        # kernel has no int8 path, so the engine degrades that combination
+        # to the gather with a logged warning (llama.resolve_attention_impl)
+        # — the megakernel is the int8-capable fused path.
         if self.prefill_impl not in ("auto", "flash", "xla"):
             raise ValueError(f"prefill_impl must be auto|flash|xla, got {self.prefill_impl!r}")
         if self.moe_dispatch not in ("auto", "dense", "ragged", "capacity"):

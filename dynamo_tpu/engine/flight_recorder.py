@@ -120,37 +120,63 @@ class StepCostModel:
     - FLOPs ≈ 2 · params · tokens (the matmul-dominated transformer count;
       attention FLOPs are second-order at serving context lengths).
     - Bytes: decode/mixed steps stream the whole parameter set once per
-      dispatch plus the active KV they gather; prefill writes its chunk's
-      KV and re-reads the prefix.
+      parameter pass plus the active KV they read; prefill writes its
+      chunk's KV and re-reads the prefix.
 
     ``param_count``/``param_bytes`` come from the actual params pytree and
     ``kv_bytes_per_token`` from the actual cache arrays, so quantized
     deployments (int8 weights/KV) are modeled at their real byte widths.
+
+    ``kv_read_factor`` models the attention path's traffic amplification
+    over the true prefix bytes: the XLA width-bucketed gather materializes
+    a packed copy (gather read + copy write + attend re-read ⇒ 3.0), while
+    the paged Pallas paths — the opt-in r5 kernel and the ragged
+    megakernel — stream each page HBM→VMEM exactly once (1.0). With the
+    factor wrong the live ``hbm_frac_decode`` gauge would report the
+    megakernel at a third of its real roofline fraction (or the gather at
+    3× — either way, not the number BENCH_r* anchors).
     """
 
     __slots__ = ("param_count", "param_bytes", "kv_bytes_per_token",
-                 "peak_flops", "peak_bw")
+                 "kv_read_factor", "peak_flops", "peak_bw")
 
     def __init__(self, param_count: int, param_bytes: int, kv_bytes_per_token: float,
-                 peak_flops: Optional[float] = None, peak_bw: Optional[float] = None):
+                 peak_flops: Optional[float] = None, peak_bw: Optional[float] = None,
+                 kv_read_factor: float = 1.0):
         self.param_count = max(int(param_count), 1)
         self.param_bytes = max(int(param_bytes), 1)
         self.kv_bytes_per_token = max(float(kv_bytes_per_token), 0.0)
+        self.kv_read_factor = max(float(kv_read_factor), 0.0)
         if peak_flops is None or peak_bw is None:
             peak_flops, peak_bw = detect_peaks()
         self.peak_flops = peak_flops
         self.peak_bw = peak_bw
 
-    def step_cost(self, tokens: int, kv_read_tokens: int) -> Tuple[float, float]:
+    def step_cost(
+        self, tokens: int, kv_read_tokens: int, param_passes: float = 1.0
+    ) -> Tuple[float, float]:
         """(flops, bytes) for one dispatch computing ``tokens`` token rows
-        while gathering ``kv_read_tokens`` of resident KV."""
+        while reading ``kv_read_tokens`` of resident KV.
+
+        ``param_passes``: how many times the dispatch streams the parameter
+        set from HBM — 1 for single steps, ``num_steps`` for a
+        ``decode_multi`` window (the fori_loop re-reads weights every
+        step), and 1 again for the fused megakernel window (weights are
+        VMEM-resident for the whole window; that is the launch-amortization
+        win the gauge must show)."""
         flops = 2.0 * self.param_count * tokens
         bytes_moved = (
-            self.param_bytes
-            + kv_read_tokens * self.kv_bytes_per_token  # gathered context
+            self.param_bytes * max(param_passes, 1.0)
+            + kv_read_tokens * self.kv_bytes_per_token * self.kv_read_factor
             + tokens * self.kv_bytes_per_token  # written KV rows
         )
         return flops, bytes_moved
+
+    def roofline_time(self, flops: float, bytes_moved: float) -> float:
+        """Lower-bound seconds for (flops, bytes) on this chip — the
+        max(compute, bandwidth) roofline. Used to split a mixed step's
+        wall time between its phases."""
+        return max(flops / self.peak_flops, bytes_moved / self.peak_bw)
 
 
 class _PhaseRoofline:
@@ -203,6 +229,12 @@ class FlightRecorder:
         # the bubble the overlap pipeline exists to close. Only consecutive
         # decode-family dispatches are measured (phase changes reset it).
         self._gap = _PhaseHist(GAP_BUCKETS)
+        # Fused decode-window launch accounting: the number of pallas_call
+        # sites traced into ONE fused-window executable (must be exactly 1 —
+        # the whole point of the megakernel window is one launch per window;
+        # CI asserts it) and how many fused windows have been dispatched.
+        self.fused_window_pallas_launches: Optional[int] = None
+        self.fused_windows_total = 0
         # Compile tracker state.
         self._exec_keys: Set[tuple] = set()
         self.compiles_total = 0
@@ -221,7 +253,8 @@ class FlightRecorder:
         self.cost_model = model
 
     def record_step(
-        self, phase: str, dur_s: float, tokens: int, kv_read_tokens: int = 0
+        self, phase: str, dur_s: float, tokens: int, kv_read_tokens: int = 0,
+        param_passes: float = 1.0,
     ) -> None:
         h = self._hists.get(phase)
         if h is None:
@@ -234,11 +267,70 @@ class FlightRecorder:
         if self.telemetry is not None:
             self.telemetry.observe(f"{phase}_step", dur_s)
         if self.cost_model is not None:
-            flops, bytes_moved = self.cost_model.step_cost(tokens, kv_read_tokens)
-            r = self._roofline.get(phase)
-            if r is None:
-                r = self._roofline.setdefault(phase, _PhaseRoofline())
-            r.record(flops, bytes_moved, dur_s)
+            flops, bytes_moved = self.cost_model.step_cost(
+                tokens, kv_read_tokens, param_passes
+            )
+            self._record_roofline(phase, flops, bytes_moved, dur_s)
+
+    def _record_roofline(
+        self, phase: str, flops: float, bytes_moved: float, dur_s: float
+    ) -> None:
+        r = self._roofline.get(phase)
+        if r is None:
+            r = self._roofline.setdefault(phase, _PhaseRoofline())
+        r.record(flops, bytes_moved, dur_s)
+
+    def record_mixed_step(
+        self,
+        dur_s: float,
+        prefill_tokens: int,
+        decode_tokens: int,
+        kv_read_prefill: int = 0,
+        kv_read_decode: int = 0,
+    ) -> None:
+        """One MIXED prefill+decode dispatch. The step histogram stays under
+        the "mixed" phase (steps/time/tokens counters unchanged), but the
+        FLOPs/bytes roofline account is SPLIT into the prefill and decode
+        buckets: when the fused kernel serves both phases in one launch,
+        charging everything to "mixed" would starve ``mfu_prefill`` and
+        ``hbm_frac_decode`` of exactly the traffic mixed steps carry —
+        under heavy mixed batching those gauges would decay to zero while
+        the engine is at peak. Wall time is apportioned by each phase's
+        roofline-time share (prefill chunks are FLOPs-bound, decode rows
+        bytes-bound, so a 50/50 token split is NOT a 50/50 time split)."""
+        h = self._hists["mixed"]
+        h.observe(dur_s, prefill_tokens + decode_tokens)
+        self.last_step_phase = "mixed"
+        self.last_step_s = dur_s
+        self.last_step_ts = time.monotonic()
+        self.recent_steps.append(
+            (self.last_step_ts, "mixed", round(dur_s, 6), prefill_tokens + decode_tokens)
+        )
+        if self.telemetry is not None:
+            self.telemetry.observe("mixed_step", dur_s)
+        if self.cost_model is None:
+            return
+        # The parameter stream is shared by both phases in one dispatch —
+        # attribute it to the decode rows (a mixed step exists because the
+        # decode batch was running anyway; the chunk rides for free).
+        f_p, b_p = self.cost_model.step_cost(prefill_tokens, kv_read_prefill, 0.0)
+        f_d, b_d = self.cost_model.step_cost(decode_tokens, kv_read_decode, 1.0)
+        t_p = self.cost_model.roofline_time(f_p, b_p)
+        t_d = self.cost_model.roofline_time(f_d, b_d)
+        share_p = t_p / (t_p + t_d) if (t_p + t_d) > 0 else 0.5
+        if prefill_tokens > 0:
+            self._record_roofline("prefill", f_p, b_p, dur_s * share_p)
+        if decode_tokens > 0:
+            self._record_roofline("decode", f_d, b_d, dur_s * (1.0 - share_p))
+
+    def record_window_launches(self, n: int) -> None:
+        """Pallas launch sites traced into one fused decode-window
+        executable (megakernel.trace_launch_count delta across its first
+        trace). Exported as the ``fused_window_pallas_launches`` gauge; CI
+        asserts == 1 so dispatch-amortization regressions — someone
+        un-fusing the window back into per-step or per-piece kernels —
+        fail loudly instead of silently re-losing to overhead."""
+        self.fused_window_pallas_launches = int(n)
 
     def utilization(self) -> Dict[str, Tuple[float, float]]:
         """{phase: (mfu, hbm_roofline_fraction)} over the recent-step
@@ -300,6 +392,12 @@ class FlightRecorder:
             "decode_host_gap_events_total": self._gap.total,
             "decode_host_gap_seconds_total": round(self._gap.sum_s, 6),
         }
+        if self.fused_windows_total or self.fused_window_pallas_launches is not None:
+            out["fused_windows_total"] = self.fused_windows_total
+            out["fused_window_pallas_launches"] = (
+                self.fused_window_pallas_launches
+                if self.fused_window_pallas_launches is not None else 0
+            )
         for phase, h in self._hists.items():
             if not h.total and phase not in ("prefill", "decode", "mixed"):
                 continue  # wave/spec only when the path is exercised

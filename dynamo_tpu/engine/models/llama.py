@@ -326,16 +326,74 @@ def _on_tpu() -> bool:
         return False
 
 
+_warned_paged_int8 = False
+
+
+def resolve_attention_impl(c: ModelConfig, k_cache) -> str:
+    """Resolve ``ModelConfig.attention_impl`` against the backend and the
+    cache dtype → one of ``"gather" | "paged" | "megakernel"``.
+
+    - ``"auto"`` flips to the ragged megakernel on TPU (where its
+      one-launch-per-layer amortization wins — see the attention_impl
+      docstring for the measured record) and stays on the XLA gather off-
+      TPU (interpreted Pallas is test-only).
+    - ``"paged"`` (the r5 per-piece kernel) has no int8 path; int8-KV
+      deployments degrade to the gather with a logged warning instead of
+      the former hard ValueError — the megakernel is the int8-capable
+      fused path.
+    """
+    global _warned_paged_int8
+    impl = c.attention_impl
+    if impl == "auto":
+        impl = "megakernel" if _on_tpu() else "gather"
+    if impl == "paged" and isinstance(k_cache, QuantKv):
+        if not _warned_paged_int8:
+            _warned_paged_int8 = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "attention_impl='paged' has no int8-KV path — degrading to "
+                "the XLA gather for this deployment. Use "
+                "attention_impl='megakernel' for the fused int8 "
+                "dequant-in-VMEM path."
+            )
+        impl = "gather"
+    return impl
+
+
 def _use_paged_decode(c: ModelConfig, k_cache) -> bool:
-    """Static choice of the decode prefix-attention backend. The Pallas
-    paged flash kernel (attention/decode.py) is explicit opt-in only —
-    "auto" resolves to the gather: on the current runtime per-pallas-call
-    dispatch overhead (ms-scale, measured with no-op kernels) dwarfs the
-    kernel's memory-traffic win at 16 calls per decode step. See
-    ModelConfig.attention_impl for the full record. No int8 path."""
-    if isinstance(k_cache, QuantKv):
-        return False
-    return c.attention_impl == "paged"
+    """The r5 per-piece Pallas paged kernel (attention/decode.py) — still
+    explicit opt-in only; superseded by the ragged megakernel for the
+    fused path. int8 caches degrade to gather (resolve_attention_impl)."""
+    return resolve_attention_impl(c, k_cache) == "paged"
+
+
+def _use_megakernel(c: ModelConfig, k_cache) -> bool:
+    """Ragged paged-attention megakernel (attention/megakernel.py): ONE
+    launch per layer serves every row of the step — prefill chunks,
+    mixed-step ragged batches, and decode rows — with no gathered prefix
+    copy and pl.when-skipped dead slots. Auto-selected on TPU."""
+    return resolve_attention_impl(c, k_cache) == "megakernel"
+
+
+def _mega_attend_rows(
+    c: ModelConfig,
+    q: jax.Array,  # [NQ, H, HD]
+    k_extra: jax.Array,  # [CK, KVH, HD]
+    v_extra: jax.Array,
+    k_flat,  # [L*N, BS, KVH, HD] layer-flat pages (QuantKv ok)
+    v_flat,
+    tables: jax.Array,  # [R, W] layer-offset page tables
+    meta: jax.Array,  # [5, NQ] megakernel.build_meta
+) -> jax.Array:
+    """One fused ragged-attention launch for a whole step's rows."""
+    from dynamo_tpu.engine.attention.megakernel import ragged_paged_attention
+
+    return ragged_paged_attention(
+        q, k_extra, v_extra, k_flat, v_flat, tables, meta,
+        num_kv_heads=c.num_kv_heads, block_size=c.block_size,
+        interpret=not _on_tpu(),
+    )
 
 
 def _paged_prefix_partials(c: ModelConfig, q, k_flat, v_flat, tables_l, lengths):
@@ -463,6 +521,23 @@ def prefill(
     k_flat = k_cache.reshape(L * N, bs, c.num_kv_heads, c.head_dim)
     v_flat = v_cache.reshape(L * N, bs, c.num_kv_heads, c.head_dim)
 
+    use_mega = _use_megakernel(c, k_cache)
+    if use_mega:
+        # The prefill chunk is one ragged megakernel row: causal fresh
+        # chunk + paged prefix in ONE launch per layer — no gathered
+        # prefix copy, pad queries (and fresh prefills' empty prefix)
+        # skipped dead in-kernel.
+        from dynamo_tpu.engine.attention.megakernel import build_meta
+
+        t_iq = jnp.arange(T, dtype=jnp.int32)
+        mega_meta = build_meta(
+            jnp.zeros((T,), jnp.int32),
+            jnp.full((T,), cache_len, jnp.int32),
+            jnp.zeros((T,), jnp.int32),
+            t_iq + 1,
+            (t_iq < valid_len).astype(jnp.int32),
+        )
+
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index
         lp = dequant_layer(lp, h.dtype)  # int8 weight-only storage
@@ -472,6 +547,20 @@ def prefill(
         v = (x @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
+
+        if use_mega:
+            attn = _mega_attend_rows(
+                c, q, k, v, k_flat, v_flat,
+                (block_table + l * N)[None, :], mega_meta,
+            ).astype(h.dtype)
+            h = h + attn.reshape(T, c.q_size) @ lp["wo"]
+            x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+            if moe_stats:
+                mlp_out, drops = _mlp(x, lp, c, valid=valid_q, stats=True)
+                h = h + mlp_out
+                return h, (k, v, drops)
+            h = h + _mlp(x, lp, c, valid=valid_q)
+            return h, (k, v)
 
         # Ragged chunk attention over [cached prefix ; chunk] — shared with
         # the mixed prefill+decode step (attention/ragged.py). The prefix
@@ -599,6 +688,7 @@ def decode_multi(
     if (
         num_steps > 1
         and not _use_paged_decode(c, k_cache)
+        and not _use_megakernel(c, k_cache)
         and hoist_bytes <= _hoist_gather_budget()
     ):
         k_flat = k_cache.reshape(L * N, bs, KVH, HD)
@@ -664,6 +754,49 @@ def decode_multi(
     return out, k_new, v_new
 
 
+def decode_multi_fused(
+    params: Params,
+    config: ModelConfig,
+    k_cache: jax.Array,  # [L, N, BS, KVH, HD]
+    v_cache: jax.Array,
+    tokens: jax.Array,  # [B] current token per sequence
+    positions: jax.Array,  # [B] write slot of the current token
+    block_tables: jax.Array,  # [B, W] — must cover positions+num_steps
+    active: jax.Array,  # [B] bool
+    num_steps: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``num_steps`` GREEDY decode steps in ONE Pallas launch — the fused
+    window megakernel (attention/megakernel.fused_decode_window). The grid
+    spans (steps × layers); the sampled token feeds back through on-chip
+    scratch between grid steps and KV rows are written in place, so the
+    per-``pallas_call`` dispatch tax that killed the r4 kernel is paid
+    once per WINDOW instead of ``num_steps × num_layers`` times, and the
+    prefix pages are the only KV bytes read. Token-for-token and
+    cache-content parity with greedy ``decode_multi`` (tested).
+
+    Dense llama only (no MoE, no int8 weights, greedy rows) — callers gate
+    via ``megakernel.fused_window_fits`` and fall back to ``decode_multi``
+    (whose attention still runs the per-step ragged megakernel)."""
+    from dynamo_tpu.engine.attention.megakernel import fused_decode_window
+
+    c = config
+    lp = params["layers"]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return fused_decode_window(
+        params["embed"], head, params["final_norm"],
+        lp["attn_norm"], lp["mlp_norm"],
+        lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+        lp["w_gate"], lp["w_up"], lp["w_down"],
+        k_cache, v_cache, tokens, positions, block_tables, active,
+        num_steps=num_steps, num_heads=c.num_heads,
+        num_kv_heads=c.num_kv_heads, head_dim=c.head_dim,
+        block_size=c.block_size, rms_eps=c.rms_norm_eps,
+        theta=c.rope_theta, interpret=not _on_tpu(),
+    )
+
+
 def _decode_layer_scan_window(
     layers: Dict[str, jax.Array],
     c: ModelConfig,
@@ -716,9 +849,22 @@ def _decode_layer_scan_window(
 
     hoisted = k_ctx_all is not None
     use_paged = not hoisted and _use_paged_decode(c, k_cache)
+    use_mega = not hoisted and _use_megakernel(c, k_cache)
     # Prefix length is fixed for the whole window (mask0 semantics): the
     # window rows live in the carry, not the cache.
     win_prefix_lens = jnp.minimum(positions - step, ctx).astype(jnp.int32)
+    if use_mega:
+        # Megakernel row metadata: each decode query's fresh keys are its
+        # row's slice of [current ; window rows] — a contiguous [start,
+        # end) column window, end advancing with the in-window step (the
+        # not-yet-written carry rows stay masked for free).
+        from dynamo_tpu.engine.attention.megakernel import build_meta
+
+        rows_i = jnp.arange(B, dtype=jnp.int32)
+        mega_meta = build_meta(
+            rows_i, win_prefix_lens, rows_i * (w + 1),
+            rows_i * (w + 1) + 1 + step, jnp.ones((B,), jnp.int32),
+        )
 
     def layer_fn(h, xs):
         if hoisted:
@@ -735,6 +881,26 @@ def _decode_layer_scan_window(
         v = v[:, 0]
         qg = q.reshape(B, kvh, G, hd)
 
+        if use_mega:
+            # ONE launch: paged prefix + [current ; live window rows] —
+            # the carry rows ride as the kernel's fresh-key piece.
+            k_extra = jnp.concatenate(
+                [k[:, None], jnp.swapaxes(kwl, 0, 1)], axis=1
+            ).reshape(B * (w + 1), kvh, hd)
+            v_extra = jnp.concatenate(
+                [v[:, None], jnp.swapaxes(vwl, 0, 1)], axis=1
+            ).reshape(B * (w + 1), kvh, hd)
+            attn = _mega_attend_rows(
+                c, q, k_extra, v_extra, k_flat, v_flat,
+                block_tables + l * N, mega_meta,
+            ).astype(h.dtype)
+            h = h + attn.reshape(B, c.q_size) @ lp["wo"]
+            x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+            if moe_stats:
+                mlp_out, drops = _mlp(x, lp, c, valid=active, stats=True)
+                return h + mlp_out, (k, v, drops)
+            h = h + _mlp(x, lp, c, valid=active)
+            return h, (k, v)
         if use_paged:
             m1, l1, acc1 = _paged_prefix_partials(
                 c, q, k_flat, v_flat, block_tables + l * N, win_prefix_lens
@@ -985,7 +1151,33 @@ def mixed_step(
     ctx_d = d_tables.shape[1] * bs
     d_tgt_blocks, d_tgt_offs, d_mask = decode_targets(d_positions, d_tables, d_active, bs)
     use_paged = _use_paged_decode(c, k_cache)
+    use_mega = _use_megakernel(c, k_cache)
     d_prefix_lens = jnp.minimum(d_positions, ctx_d).astype(jnp.int32)
+    if use_mega:
+        # Megakernel packing: the WHOLE mixed step's attention — the chunk's
+        # (start, len) queries AND the B length-1 decode rows — is one
+        # ragged batch sharing one grid, one launch per layer. Tables pack
+        # [chunk row ; decode rows]; padded table slots hold the scratch
+        # page and are skipped (pl.when) along with dead chunk-bucket
+        # queries and inactive decode lanes.
+        from dynamo_tpu.engine.attention.megakernel import build_meta
+
+        Wp, Wd = p_table.shape[0], d_tables.shape[1]
+        Wmax = max(Wp, Wd)
+        mega_tbl = jnp.zeros((1 + B, Wmax), jnp.int32)
+        mega_tbl = mega_tbl.at[0, :Wp].set(p_table.astype(jnp.int32))
+        mega_tbl = mega_tbl.at[1:, :Wd].set(d_tables.astype(jnp.int32))
+        s_iq = jnp.arange(S, dtype=jnp.int32)
+        d_iq = jnp.arange(B, dtype=jnp.int32)
+        mega_meta = build_meta(
+            jnp.concatenate([jnp.zeros((S,), jnp.int32), 1 + d_iq]),
+            jnp.concatenate([jnp.full((S,), p_cache_len, jnp.int32), d_prefix_lens]),
+            jnp.concatenate([jnp.zeros((S,), jnp.int32), S + d_iq]),
+            jnp.concatenate([s_iq + 1, S + d_iq + 1]),
+            jnp.concatenate(
+                [(s_iq < p_valid).astype(jnp.int32), d_active.astype(jnp.int32)]
+            ),
+        )
 
     from dynamo_tpu.engine.attention.ragged import ragged_chunk_attention
 
@@ -998,6 +1190,20 @@ def mixed_step(
         v = (x @ lp["wv"]).reshape(S + B, kvh, hd)
         q = apply_rope(q, positions_all, c.rope_theta)
         k = apply_rope(k, positions_all, c.rope_theta)
+
+        if use_mega:
+            # ONE fused launch for chunk + decode rows: the fresh-key piece
+            # is the packed [chunk K ; decode K] projection output itself.
+            attn = _mega_attend_rows(
+                c, q, k, v, k_flat, v_flat, mega_tbl + l * N, mega_meta
+            ).astype(h.dtype).reshape(S + B, c.q_size)
+            h = h + attn @ lp["wo"]
+            x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
+            if moe_stats:
+                mlp_out, drops = _mlp(x, lp, c, valid=valid_all, stats=True)
+                return h + mlp_out, (k, v, drops)
+            h = h + _mlp(x, lp, c, valid=valid_all)
+            return h, (k, v)
 
         # Chunk piece: [cached prefix ; chunk] — prefill's exact math.
         if use_flash and not has_prefix:
@@ -1220,7 +1426,15 @@ def decode_layer_scan(
     kvh, G, hd = c.num_kv_heads, c.num_heads // c.num_kv_heads, c.head_dim
     scale = hd**-0.5
     use_paged = _use_paged_decode(c, k_cache)
+    use_mega = _use_megakernel(c, k_cache)
     prefix_lens = jnp.minimum(positions, ctx).astype(jnp.int32)
+    if use_mega:
+        from dynamo_tpu.engine.attention.megakernel import build_meta
+
+        rows_i = jnp.arange(B, dtype=jnp.int32)
+        mega_meta = build_meta(
+            rows_i, prefix_lens, rows_i, rows_i + 1, jnp.ones((B,), jnp.int32)
+        )
 
     def layer_fn(h, xs):
         lp, l = xs  # l: scalar layer index within this stack
@@ -1235,19 +1449,27 @@ def decode_layer_scan(
         qg = q.reshape(B, kvh, G, hd)
 
         tables_l = block_tables + l * N
-        # Two online-softmax pieces: cached prefix + current token
-        # in-register. Prefix: Pallas paged flash kernel (pages stream
-        # HBM→VMEM once) or the width-bucketed XLA gather fallback.
-        if use_paged:
-            m1, l1, acc1 = _paged_prefix_partials(c, q, k_flat, v_flat, tables_l, prefix_lens)
+        if use_mega:
+            # Ragged megakernel: prefix pages + the current token merge
+            # inside ONE launch's online softmax — no gathered copy, no
+            # external piece merge (attention/megakernel.py).
+            attn = _mega_attend_rows(
+                c, q, k, v, k_flat, v_flat, tables_l, mega_meta
+            ).astype(h.dtype)
         else:
-            k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-            v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
-            m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask, scale)
-        m2, l2, acc2 = _attend_piece(
-            qg, k[:, None], v[:, None], jnp.ones((B, 1), dtype=bool), scale
-        )
-        attn = _merge_pieces(m1, l1, acc1, m2, l2, acc2).astype(h.dtype)
+            # Two online-softmax pieces: cached prefix + current token
+            # in-register. Prefix: Pallas paged flash kernel (pages stream
+            # HBM→VMEM once) or the width-bucketed XLA gather fallback.
+            if use_paged:
+                m1, l1, acc1 = _paged_prefix_partials(c, q, k_flat, v_flat, tables_l, prefix_lens)
+            else:
+                k_ctx = _gather_kv(k_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+                v_ctx = _gather_kv(v_flat, tables_l, h.dtype).reshape(B, ctx, kvh, hd)
+                m1, l1, acc1 = _attend_piece(qg, k_ctx, v_ctx, mask, scale)
+            m2, l2, acc2 = _attend_piece(
+                qg, k[:, None], v[:, None], jnp.ones((B, 1), dtype=bool), scale
+            )
+            attn = _merge_pieces(m1, l1, acc1, m2, l2, acc2).astype(h.dtype)
         h = h + attn.reshape(B, c.q_size) @ lp["wo"]
 
         x = rms_norm(h, lp["mlp_norm"], c.rms_norm_eps)
@@ -1304,10 +1526,9 @@ def decode(
 
     tgt_blocks, tgt_offs, mask = decode_targets(positions, block_tables, active, bs)
 
-    # Decode attention is the width-bucketed XLA gather with a two-piece
-    # online-softmax merge (cached prefix + current token in-register). A
-    # Pallas paged-DMA kernel was measured 3-6x slower in every regime and
-    # deleted in r4 — see ModelConfig.attention_impl for the full record.
+    # Decode attention: the ragged megakernel (one launch per layer, TPU
+    # auto) or the width-bucketed XLA gather with a two-piece online-
+    # softmax merge — see ModelConfig.attention_impl for the full record.
     if moe_stats:
         h, k_rows, v_rows, drops = decode_layer_scan(
             params["layers"], c, k_cache, v_cache, h, positions,

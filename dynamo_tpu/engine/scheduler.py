@@ -400,7 +400,21 @@ class Scheduler:
         kv_leaves = jax.tree_util.tree_leaves((self.cache.k, self.cache.v))
         kv_bytes = sum(int(x.size) * x.dtype.itemsize for x in kv_leaves)
         kv_per_token = kv_bytes / max(self.sc.num_blocks * model_config.block_size, 1)
-        self.flight.set_cost_model(StepCostModel(param_count, param_bytes, kv_per_token))
+        # KV-read traffic factor per attention path: the XLA gather's
+        # read + packed-copy write + attend re-read moves 3× the true
+        # prefix bytes; the paged Pallas paths (r5 kernel, megakernel)
+        # stream each page once. Without this the hbm_frac_decode gauge
+        # can't reflect the megakernel's actual roofline position.
+        self._attn_impl = "gather"
+        if model_config.architecture == "llama":
+            self._attn_impl = llama.resolve_attention_impl(model_config, self.cache.k)
+        kv_read_factor = 1.0 if self._attn_impl in ("paged", "megakernel") else 3.0
+        self.flight.set_cost_model(
+            StepCostModel(param_count, param_bytes, kv_per_token,
+                          kv_read_factor=kv_read_factor)
+        )
+        self._param_bytes = param_bytes
+        self._kv_cache_bytes = kv_bytes
 
         # Trim buckets to the model's max length.
         self.sc.prefill_buckets = [b for b in self.sc.prefill_buckets if b <= model_config.max_seq_len] or [
@@ -566,6 +580,38 @@ class Scheduler:
                 {w for w in (8, 16, self.sc.num_scheduler_steps) if w <= self.sc.num_scheduler_steps}
             )
             self._decode_multi_jits = {w: mk_multi(w) for w in self._window_rungs}
+        # Fused megakernel decode window (llama.decode_multi_fused): a whole
+        # greedy N-step window in ONE pallas launch — embedding, layers,
+        # paged attention, lm_head, argmax, and KV writes inside one grid
+        # with on-chip token feedback. Dense bf16/f32 llama only (no MoE /
+        # int8 weights / quantized KV), and only where the working set fits
+        # VMEM (fused_window_fits); everything else keeps decode_multi,
+        # whose per-step attention still runs the ragged megakernel.
+        self._use_fused_window = False
+        if (
+            self._supports_multi_step
+            and self.sc.num_scheduler_steps > 1
+            and hasattr(model, "decode_multi_fused")
+            and self._attn_impl == "megakernel"
+            and model_config.num_experts == 0
+            and model_config.weight_dtype != "int8"
+            and model_config.kv_cache_dtype != "int8"
+        ):
+            from dynamo_tpu.engine.attention.megakernel import fused_window_fits
+
+            self._use_fused_window = fused_window_fits(
+                self._param_bytes, self._kv_cache_bytes
+            )
+        if self._use_fused_window:
+            def mk_fused(steps: int):
+                return jax.jit(
+                    lambda p, k, v, t, pos, bt, act: model.decode_multi_fused(
+                        p, self.mc, k, v, t, pos, bt, act, steps
+                    ),
+                    donate_argnums=(1, 2),
+                )
+
+            self._decode_fused_jits = {w: mk_fused(w) for w in self._window_rungs}
 
     def attach_draft(self, draft_config: ModelConfig, draft_params, *, gamma: int = 4) -> None:
         """Enable batched speculative decoding: the draft model proposes γ
@@ -1014,9 +1060,14 @@ class Scheduler:
             # Decode rows first (output-order parity with the phase-separated
             # decode-then-admit iteration), then the chunk's progress.
             self._finish_decode_rows(batch, d_bucket, logits[1:], outputs)
-        self.flight.record_step(
-            "mixed", timer.dur, len(chunk_tokens) + n,
-            kv_read_tokens=sum(s.total_len for s in batch) + seq.num_computed,
+        # Mixed-step roofline split: the chunk's FLOPs/bytes land in the
+        # PREFILL bucket and the decode rows' in DECODE, so mfu_prefill /
+        # hbm_frac_decode stay truthful when one fused launch serves both
+        # phases (the step histogram itself stays under "mixed").
+        self.flight.record_mixed_step(
+            timer.dur, len(chunk_tokens), n,
+            kv_read_prefill=seq.num_computed,
+            kv_read_decode=sum(s.total_len for s in batch),
         )
         self.telemetry.observe("itl", timer.dur)
         self._trace_event(
@@ -1459,6 +1510,26 @@ class Scheduler:
                                 active, temps, tks, tps, key,
                             )
                         )
+                        count += 1
+                if self._use_fused_window:
+                    # Fused megakernel windows: same (steps, bucket, width)
+                    # key space as decode_multi. The first trace also
+                    # records the launches-per-window gauge (must be 1).
+                    from dynamo_tpu.engine.attention import megakernel as _mk
+
+                    for w, fjit in self._decode_fused_jits.items():
+                        new_exec = self.flight.record_exec(
+                            "decode_fused", (w, bucket, width)
+                        )
+                        launches0 = _mk.trace_launch_count()
+                        _, self.cache.k, self.cache.v = fjit(
+                            self.params, self.cache.k, self.cache.v,
+                            toks, pos, tables, active,
+                        )
+                        if new_exec:
+                            self.flight.record_window_launches(
+                                _mk.trace_launch_count() - launches0
+                            )
                         count += 1
             self._sample_jit(
                 jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
@@ -2111,6 +2182,49 @@ class Scheduler:
             active[i] = True
         tables = self._decode_tables(batch, bucket, width)
 
+        # Fused megakernel window: all-greedy batches dispatch the whole
+        # N-step window as ONE pallas launch (grid = steps × layers, token
+        # feedback through on-chip scratch) — the per-launch dispatch tax is
+        # paid once per WINDOW and the weights/prefix are read once, not
+        # ``steps`` times. Non-greedy rows keep the sampled decode_multi.
+        if self._use_fused_window and all(
+            s.sampling.temperature == 0 for s in batch
+        ):
+            from dynamo_tpu.engine.attention import megakernel as _mk
+
+            new_exec = self.flight.record_exec("decode_fused", (steps, bucket, width))
+            launches0 = _mk.trace_launch_count() if new_exec else 0
+            n0 = len(outputs)
+            with StepTimer() as timer:
+                self._record_host_gap()
+                toks_out, self.cache.k, self.cache.v = self._decode_fused_jits[steps](
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(tokens), jnp.asarray(positions), tables,
+                    jnp.asarray(active),
+                )
+                self._note_decode_dispatch()
+                sampled = np.asarray(toks_out)  # the one host sync per window
+
+                for i, seq in enumerate(batch):
+                    for s in range(steps):
+                        if seq.state != SeqState.RUNNING:
+                            break
+                        self._append_token(seq, int(sampled[s, i]), outputs)
+            if new_exec:
+                # Launch sites traced into this window executable — the
+                # amortization invariant (== 1) CI asserts.
+                self.flight.record_window_launches(_mk.trace_launch_count() - launches0)
+            self.flight.fused_windows_total += 1
+            self.flight.record_step(
+                "decode", timer.dur, len(outputs) - n0,
+                # VMEM-resident window: weights and prefix stream from HBM
+                # once per window, not once per step.
+                kv_read_tokens=sum(s.total_len for s in batch),
+                param_passes=1.0,
+            )
+            self.telemetry.observe("itl", timer.dur / max(steps, 1))
+            return True
+
         self._step_counter += 1
         key = jax.random.fold_in(self._rng, self._step_counter)
         self.flight.record_exec("decode_multi", (steps, bucket, width))
@@ -2135,6 +2249,8 @@ class Scheduler:
         self.flight.record_step(
             "decode", timer.dur, len(outputs) - n0,
             kv_read_tokens=steps * sum(s.total_len for s in batch),
+            # The fori_loop window re-streams the parameter set every step.
+            param_passes=float(steps),
         )
         self.telemetry.observe("itl", timer.dur / max(steps, 1))
         return True

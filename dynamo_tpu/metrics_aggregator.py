@@ -38,6 +38,9 @@ GAUGE_KEYS = (
     "hbm_frac_wave", "hbm_frac_spec",
     # Stall watchdog: 1.0 = step loop wedged with work queued.
     "engine_stalled", "last_step_age_s",
+    # Pallas launch sites traced into one fused decode-window executable
+    # (must be exactly 1; CI asserts — see flight_recorder).
+    "fused_window_pallas_launches",
 )
 
 # Fleet-level digest families the aggregator re-exports (merged across
@@ -86,6 +89,8 @@ COUNTER_KEYS = (
     "step_spec_flops_total", "step_spec_bytes_total",
     # Stall watchdog transitions (each is one wedged-engine incident).
     "engine_stalls_total",
+    # Fused megakernel decode windows dispatched (one pallas launch each).
+    "fused_windows_total",
 )
 
 
