@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -437,9 +438,13 @@ class Scheduler:
             and model_config.num_experts > 0
             and model_config.moe_dispatch == "capacity"
         )
-        self._moe_dropped_total = 0
-        self._moe_assignments_total = 0
+        self._moe_dropped_total = 0  # guarded-by: _aux_lock
+        self._moe_assignments_total = 0  # guarded-by: _aux_lock
         self._pending_aux: list = []
+        # _drain_aux runs on the step thread (overflow drain in
+        # _consume_aux) AND the event loop (metrics()/moe_* properties via
+        # the stats scrape): the swap-and-accumulate must not interleave.
+        self._aux_lock = threading.Lock()
         # llama-only kwargs (MLA's forward has its own signature).
         stats_kw = {"moe_stats": True} if self._moe_stats else {}
         if self._use_flash_prefill:
@@ -2529,10 +2534,11 @@ class Scheduler:
     def _drain_aux(self) -> None:
         if not self._pending_aux:
             return
-        pend, self._pending_aux = self._pending_aux, []
-        vals = jax.device_get(pend)  # one transfer for the whole batch
-        self._moe_dropped_total += int(sum(int(d) for d, _ in vals))
-        self._moe_assignments_total += int(sum(int(a) for _, a in vals))
+        with self._aux_lock:
+            pend, self._pending_aux = self._pending_aux, []
+            vals = jax.device_get(pend)  # one transfer for the whole batch
+            self._moe_dropped_total += int(sum(int(d) for d, _ in vals))
+            self._moe_assignments_total += int(sum(int(a) for _, a in vals))
 
     def _prefill_mm_jit(self):
         """Lazy jit of the multimodal prefill variant (feature injection)."""

@@ -9,6 +9,7 @@ reuses the same executable.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -24,7 +25,7 @@ def _gather(k_cache: jax.Array, v_cache: jax.Array, block_id: jax.Array) -> Tupl
     return k_cache[:, block_id], v_cache[:, block_id]
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0, 1))
 def _scatter(k_cache: jax.Array, v_cache: jax.Array, block_id: jax.Array, k: jax.Array, v: jax.Array):
     return k_cache.at[:, block_id].set(k), v_cache.at[:, block_id].set(v)
 
@@ -49,7 +50,7 @@ def _gather_one_quant(qkv: QuantKv, block_id: jax.Array) -> jax.Array:
     return (qkv.q[:, block_id].astype(jnp.float32) * qkv.scale[:, block_id]).astype(jnp.float32)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _scatter_one_quant(qkv: QuantKv, block_id: jax.Array, rows: jax.Array) -> QuantKv:
     qk = quantize_kv_rows(rows)
     return QuantKv(qkv.q.at[:, block_id].set(qk.q), qkv.scale.at[:, block_id].set(qk.scale))
@@ -100,7 +101,7 @@ def _gather_k(k_cache: jax.Array, block_id: jax.Array) -> jax.Array:
     return k_cache[:, block_id]
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _scatter_k(k_cache: jax.Array, block_id: jax.Array, k: jax.Array) -> jax.Array:
     return k_cache.at[:, block_id].set(k)
 
@@ -118,7 +119,7 @@ def _gather_many(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
     return cache[:, block_ids]
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _scatter_many(cache: jax.Array, block_ids: jax.Array, blocks: jax.Array) -> jax.Array:
     return cache.at[:, block_ids].set(blocks)
 
@@ -128,7 +129,7 @@ def _gather_many_quant(qkv: QuantKv, block_ids: jax.Array) -> jax.Array:
     return (qkv.q[:, block_ids].astype(jnp.float32) * qkv.scale[:, block_ids]).astype(jnp.bfloat16)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _scatter_many_quant(qkv: QuantKv, block_ids: jax.Array, blocks: jax.Array) -> QuantKv:
     qk = quantize_kv_rows(blocks)
     return QuantKv(qkv.q.at[:, block_ids].set(qk.q), qkv.scale.at[:, block_ids].set(qk.scale))

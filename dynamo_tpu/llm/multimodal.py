@@ -142,7 +142,10 @@ class EncodeWorkerHandler:
         yield features_to_wire(feats)
 
     def stats_handler(self) -> dict:
-        return {"requests_total": self.requests_total}
+        # Wire key matches the aggregator's registered counter name
+        # (COUNTER_KEYS has "request_total" — an encode-worker fleet scrape
+        # would silently drop a "requests_total" key).
+        return {"request_total": self.requests_total}
 
 
 class EncodeOperator(Operator):

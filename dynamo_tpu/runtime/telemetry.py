@@ -410,8 +410,12 @@ class StallWatchdog:
         self.stall_after_s = stall_after_s
         self._clock = clock
         self._start_ts = clock()
-        self.stalled = False
-        self.stalls_total = 0
+        # check() is called from every poller (stats scrape on the event
+        # loop, the health server's thread, bench loops): the transition
+        # edge must fire its counter exactly once.
+        self._check_lock = threading.Lock()
+        self.stalled = False  # guarded-by: _check_lock
+        self.stalls_total = 0  # guarded-by: _check_lock
 
     def last_step_age_s(self) -> float:
         _, last = self.probe()
@@ -424,13 +428,14 @@ class StallWatchdog:
         has_work, last = self.probe()
         ref = self._start_ts if last is None else last
         now_stalled = bool(has_work) and (self._clock() - ref) > self.stall_after_s
-        if now_stalled and not self.stalled:
-            self.stalls_total += 1
-            logger.error(
-                "engine_stalled: step loop has not advanced for %.1fs with work queued",
-                self._clock() - ref,
-            )
-        self.stalled = now_stalled
+        with self._check_lock:
+            if now_stalled and not self.stalled:
+                self.stalls_total += 1
+                logger.error(
+                    "engine_stalled: step loop has not advanced for %.1fs with work queued",
+                    self._clock() - ref,
+                )
+            self.stalled = now_stalled
         return now_stalled
 
     def to_stats(self) -> dict:

@@ -1,0 +1,3 @@
+# Fixture modules with KNOWN dtlint violations, marked with trailing
+# ``# expect: RULE`` comments. They are parsed by tools/dtlint (never
+# imported/executed) and are OUTSIDE the default dynamo_tpu scan scope.

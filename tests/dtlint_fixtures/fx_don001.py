@@ -1,0 +1,48 @@
+"""DON001 fixtures: KV-buffer donation hygiene."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # expect: DON001
+def scatter_nodonate(k_cache, v_cache, idx, rows):
+    # Writes both cache buffers without donating either: XLA must
+    # double-buffer the whole pool for the update. (Two findings — one
+    # per written cache param — anchor on the decorator line.)
+    return k_cache.at[idx].set(rows), v_cache.at[idx].set(rows)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def scatter_donated(k_cache, v_cache, idx, rows):
+    return k_cache.at[idx].set(rows), v_cache.at[idx].set(rows)
+
+
+@jax.jit
+def gather_readonly(k_cache, idx):
+    # Read-only: no donation required.
+    return k_cache[idx]
+
+
+def zero_block(cache, idx):
+    return cache.at[idx].set(0.0)
+
+
+_zero_jit = jax.jit(zero_block, donate_argnums=(0,))
+
+
+def caller_reuses_donated(cache, idx):
+    out = _zero_jit(cache, idx)
+    stale = cache + 1                    # expect: DON001
+    return out, stale
+
+
+def caller_reassigns(cache, idx):
+    cache = _zero_jit(cache, idx)
+    return cache + 1                     # reassigned first: clean
+
+
+@jax.jit  # dtlint: disable=DON001
+def suppressed_scatter(k_cache, idx, rows):
+    return k_cache.at[idx].set(rows)

@@ -1,0 +1,46 @@
+"""JIT001 fixtures: host impurity inside jit/pallas-reachable bodies."""
+
+import logging
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+SCALE_TABLE = [1.0, 2.0, 4.0]          # mutable module global (list)
+BUCKETS = (8, 16, 32)                  # immutable tuple: reads are fine
+
+
+@jax.jit
+def impure_kernel(x):
+    t0 = time.perf_counter()           # expect: JIT001
+    noise = random.random()            # expect: JIT001
+    print("tracing", t0)               # expect: JIT001
+    logger.info("step %s", noise)      # expect: JIT001
+    return x * SCALE_TABLE[0]          # expect: JIT001
+
+
+def helper(x):
+    # Reachable from jitted_root below via the module call graph — the
+    # impurity is flagged here even though the jit sits one level up.
+    logger.debug("helper")             # expect: JIT001
+    return x + len(BUCKETS)
+
+
+@jax.jit
+def jitted_root(x):
+    return helper(x) * 2
+
+
+@jax.jit
+def suppressed_kernel(x):
+    t = time.time()  # dtlint: disable=JIT001
+    return x + t
+
+
+def pure_host_fn(x):
+    # NOT reachable from any jit root: host calls here are fine.
+    logger.info("serving %s", time.time())
+    return x

@@ -1,0 +1,29 @@
+"""JIT002 fixtures: recompile risk at jitted call sites / static args."""
+
+import jax
+import jax.numpy as jnp
+
+
+def next_bucket(n, buckets):
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def model_step(params, tokens, table=[]):   # mutable default on a static arg
+    return tokens
+
+
+step_jit = jax.jit(model_step, static_argnums=(2,))  # expect: JIT002
+
+
+def serve(params, prompt, prompts):
+    step_jit(params, jnp.asarray(prompt), len(prompt))        # expect: JIT002
+    n = len(prompts)
+    step_jit(params, jnp.asarray(prompt), n)                  # expect: JIT002
+    bucket = next_bucket(len(prompt), [8, 16, 32])
+    step_jit(params, jnp.asarray(prompt), bucket)             # bucketed: clean
+    step_jit(params, jnp.asarray(prompt), jnp.int32(len(prompt)))  # traced: clean
+    m = len(prompts)
+    step_jit(params, jnp.asarray(prompt), m)  # dtlint: disable=JIT002

@@ -1,0 +1,23 @@
+"""MET001 fixture emitter: the worker-scrape wire keys."""
+
+PHASES = ("decode", "prefill")
+
+
+class Worker:
+    def __init__(self):
+        self.good_total = 0
+
+    def stats_handler(self) -> dict:
+        out = {
+            "good_total": self.good_total,
+            "good_gauge": 1.0,
+            "lonely_gauge": 0.5,
+            "rogue_total": 7,   # expect: MET001
+        }
+        for phase in PHASES:
+            out[f"step_{phase}_ok_total"] = 1
+        return out
+
+    def debug_dump(self) -> dict:
+        # NOT an emitter function: keys here are out of scope.
+        return {"internal_scratch_total": 1}

@@ -1,0 +1,18 @@
+"""MET001 fixture aggregator: key lists with seeded drift.
+
+- ``good_total`` / ``good_gauge``: emitted and pinned — clean.
+- ``step_decode_ok_total``: emitted via an f-string wildcard — clean.
+- ``ghost_total``: registered, never emitted, never pinned — 2 findings.
+- ``lonely_gauge``: registered + emitted but not pinned — 1 finding.
+"""
+
+GAUGE_KEYS = (
+    "good_gauge",
+    "lonely_gauge",    # expect: MET001
+)
+
+COUNTER_KEYS = (
+    "good_total",
+    "step_decode_ok_total",
+    "ghost_total",     # expect: MET001
+)

@@ -1,0 +1,41 @@
+"""SYNC001 fixtures: blocking syncs in (fixture-)hot-path functions.
+
+The fixture sync allowlist (``sync_allowlist.json`` beside this file)
+declares ``HotLoop.decode_step`` / ``HotLoop.retire`` / ``HotLoop.host_stats``
+as hot paths and sanctions exactly one sync: ``np.asarray`` in ``retire``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class HotLoop:
+    def __init__(self, params):
+        self.params = params
+        self._decode_jit = jax.jit(lambda p, t: t)
+
+    def decode_step(self, tokens_h):
+        tpa = np.zeros((3, 8), dtype=np.int32)        # host: clean
+        dev = self._decode_jit(self.params, jnp.asarray(tpa))
+        toks = np.asarray(dev)                         # expect: SYNC001
+        dev.block_until_ready()                        # expect: SYNC001
+        got = jax.device_get(dev)                      # expect: SYNC001
+        x = float(dev)                                 # expect: SYNC001
+        y = dev.item()                                 # expect: SYNC001
+        z = np.asarray(self.params)                    # expect: SYNC001
+        ok = float(len(tokens_h))                      # host float: clean
+        w = np.asarray([1, 2, 3])                      # literal: clean
+        s = np.asarray(dev)  # dtlint: disable=SYNC001
+        return toks, got, x, y, z, ok, w, s
+
+    def retire(self, pending):
+        return np.asarray(pending)                     # allowlisted: clean
+
+    def host_stats(self):
+        # Host-only bookkeeping in a hot path: nothing to flag.
+        return {"steps_total": 1}
+
+    def off_path(self, dev):
+        # NOT in the fixture hot-path list: syncs here are out of scope.
+        return np.asarray(dev)

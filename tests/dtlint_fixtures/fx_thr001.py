@@ -1,0 +1,87 @@
+"""THR001 fixtures: cross-thread field writes."""
+
+import threading
+
+
+class Exporter:
+    """Writer thread + main both mutate ``n_written`` unguarded."""
+
+    def __init__(self):
+        self.n_written = 0
+        self.started = False
+
+    def start(self):
+        self.started = True
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.n_written += 1        # expect: THR001
+
+    def reset(self):
+        self.n_written = 0
+
+
+class LockedExporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n_written = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.n_written += 1
+
+    def reset(self):
+        with self._lock:
+            self.n_written = 0
+
+
+class AnnotatedExporter:
+    """Single-writer-by-design: the annotation makes the choice visible."""
+
+    def __init__(self):
+        self.n_written = 0  # guarded-by: GIL last-write-wins, monitoring only
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.n_written += 1
+
+    def reset(self):
+        self.n_written = 0
+
+
+class Poller:
+    """Entry designated via LintConfig.thread_entries (no Thread() call in
+    sight — the poll comes from another component's thread)."""
+
+    def __init__(self):
+        self.state = "idle"
+
+    def poll(self):
+        self.state = "polled"      # expect: THR001
+
+    def reset(self):
+        self.state = "idle"
+
+    def read_only(self):
+        return self.state
+
+
+class SingleWriter:
+    """Thread entry writes; main only reads — clean."""
+
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.count += 1
+
+    def snapshot(self):
+        return self.count

@@ -235,6 +235,36 @@ def test_prefix_cache_metrics_render_in_all_roles():
         )
 
 
+def test_static_metrics_drift_dtlint_cross_check():
+    """The static half of this file's contract, via dtlint MET001: every
+    counter emitted on the worker-scrape wire is registered in
+    COUNTER_KEYS, every registered key is emitted AND pinned by a Grafana
+    panel expr, and the dashboard references no unknown worker keys — so
+    this dynamic render test and the MET001 CI gate can never drift apart
+    (they read the same key lists and the same dashboard)."""
+    import os
+
+    from tools.dtlint import LintConfig, run_lint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = run_lint(
+        LintConfig(root=repo),
+        rules=["MET001"],
+        baseline_path=os.path.join(repo, "dtlint_baseline.json"),
+    )
+    assert result.findings == [], (
+        "metrics drift (code ↔ COUNTER_KEYS/GAUGE_KEYS ↔ Grafana):\n"
+        + "\n".join(f.render() for f in result.findings)
+    )
+    assert result.stale_baseline == [], result.stale_baseline
+
+    # And the cross-check itself is wired to the same registries this
+    # file renders: a key list the aggregator doesn't actually export
+    # would fail the dynamic tests above.
+    for key in COUNTER_KEYS:
+        assert key.endswith("_total"), f"counter key {key} must end _total"
+
+
 def test_get_or_create_rejects_label_mismatch_on_reuse():
     """Regression: sibling registries reusing a collector with a DIFFERENT
     label set must get a clear error at declaration time, not a confusing

@@ -1,0 +1,18 @@
+"""dtlint: static invariant checker for this repo's jit hygiene, sync
+points, donation, metrics plumbing, and thread safety.
+
+Usage: ``python -m tools.dtlint [--rule R] [--baseline f.json] [--json]``.
+See ``tools/dtlint/README.md`` for the rule catalogue.
+"""
+
+from tools.dtlint.core import (  # noqa: F401
+    Finding,
+    LintConfig,
+    LintResult,
+    ProjectIndex,
+    RULE_DOCS,
+    RULES,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+)

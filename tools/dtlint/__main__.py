@@ -1,0 +1,88 @@
+"""CLI: ``python -m tools.dtlint [paths...]``.
+
+Exit codes: 0 = clean (modulo baseline), 1 = findings or stale baseline
+entries, 2 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.dtlint.core import LintConfig, RULE_DOCS, run_lint
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dtlint",
+        description="static invariant checker (jit hygiene, sync points, "
+                    "donation, metrics drift, thread safety)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to scan (default: dynamo_tpu)")
+    p.add_argument("--rule", action="append", default=None, metavar="RULE",
+                   help="run only this rule (repeatable, or comma-separated)")
+    p.add_argument("--baseline", default="dtlint_baseline.json",
+                   help="baseline file of reviewed findings (default: "
+                        "dtlint_baseline.json; '' disables)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--root", default=os.getcwd(), help=argparse.SUPPRESS)
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    args = p.parse_args(argv)
+
+    # Importing the rule modules populates the registry for --list-rules.
+    from tools.dtlint import rules_jit, rules_metrics, rules_sync, rules_threads  # noqa: F401
+
+    if args.list_rules:
+        for name in sorted(RULE_DOCS):
+            print(f"{name}  {RULE_DOCS[name]}")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = []
+        for r in args.rule:
+            rules.extend(x.strip() for x in r.split(",") if x.strip())
+
+    config = LintConfig(
+        root=args.root,
+        paths=tuple(args.paths) if args.paths else ("dynamo_tpu",),
+    )
+    baseline = None
+    if args.baseline:
+        baseline = (args.baseline if os.path.isabs(args.baseline)
+                    else os.path.join(args.root, args.baseline))
+    try:
+        result = run_lint(config, rules=rules, baseline_path=baseline)
+    except ValueError as e:
+        print(f"dtlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "stale_baseline": result.stale_baseline,
+            "baseline_size": result.baseline_size,
+            "ok": result.ok,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for e in result.stale_baseline:
+            print(f"{e['file']}: STALE-BASELINE [{e['rule']}/{e['qualname']}/"
+                  f"{e['key']}] no longer matches a finding — remove the "
+                  f"entry (reason was: {e['reason']})")
+        n = len(result.findings)
+        print(f"dtlint: {n} finding{'s' if n != 1 else ''}, "
+              f"{len(result.stale_baseline)} stale baseline entr"
+              f"{'ies' if len(result.stale_baseline) != 1 else 'y'} "
+              f"(baseline: {result.baseline_size})", file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

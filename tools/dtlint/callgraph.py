@@ -1,0 +1,249 @@
+"""Module-level call graph + jit/pallas root discovery.
+
+Resolution is deliberately module-local and name-based: ``f(...)`` resolves
+to a function defined in the same module, ``self.m(...)`` to a method of
+the enclosing class. That covers how this codebase actually wires its jit
+bodies (kernels and their helpers live beside their ``jax.jit`` /
+``pallas_call`` sites) without pretending to be a type checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dtlint.core import SourceModule, dotted, iter_functions
+
+_JIT_CALLS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PALLAS_CALLS = {"pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call"}
+_PARTIAL = {"partial", "functools.partial"}
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    node: ast.AST
+    cls: Optional[str]            # enclosing class name, if a method
+    calls: Set[str] = field(default_factory=set)   # resolved callee qualnames
+
+
+@dataclass
+class JitWrapper:
+    """One ``jax.jit(fn, ...)`` / ``@jax.jit`` / ``pallas_call(kernel)``
+    site: the wrapped function (when resolvable), the name the wrapper is
+    bound to (module global or ``self.X`` attribute), and donation info."""
+
+    target: Optional[str]          # wrapped function qualname, if resolved
+    bound_name: Optional[str]      # "name" or "self.attr" the wrapper binds to
+    line: int
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    donate_argnames: Tuple[str, ...] = ()
+    kind: str = "jit"              # "jit" | "pallas"
+
+
+class ModuleGraph:
+    """Call graph + jit roots for ONE module."""
+
+    def __init__(self, mod: SourceModule) -> None:
+        self.mod = mod
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.wrappers: List[JitWrapper] = []
+        self._collect_funcs()
+        self._collect_wrappers()
+        self._collect_calls()
+
+    # -- collection ----------------------------------------------------------
+    def _collect_funcs(self) -> None:
+        for q, fn in iter_functions(self.mod.tree):
+            cls = q.rsplit(".", 2)[-2] if "." in q else None
+            self.funcs[q] = FuncInfo(qualname=q, node=fn, cls=cls)
+
+    def _resolve_func_ref(self, node: ast.AST, scope: Optional[str]) -> Optional[str]:
+        """Resolve a function reference (Name / self.attr) to a qualname
+        defined in this module. ``scope`` is the enclosing qualname prefix
+        used to find nested defs and sibling methods."""
+        name = dotted(node)
+        if not name:
+            return None
+        if name.startswith("self."):
+            attr = name[len("self."):]
+            if scope and "." in scope:
+                cls = scope.rsplit(".", 1)[0]
+                cand = f"{cls}.{attr}"
+                if cand in self.funcs:
+                    return cand
+            return None
+        # nested def in the same scope wins, then module-level
+        if scope:
+            cand = f"{scope}.{name}"
+            if cand in self.funcs:
+                return cand
+        if name in self.funcs:
+            return name
+        return None
+
+    @staticmethod
+    def _int_tuple(node: Optional[ast.AST]) -> Tuple[int, ...]:
+        if node is None:
+            return ()
+        try:
+            v = ast.literal_eval(node)
+        except (ValueError, SyntaxError):
+            return ()
+        if isinstance(v, int):
+            return (v,)
+        if isinstance(v, (tuple, list)):
+            return tuple(x for x in v if isinstance(x, int))
+        return ()
+
+    @staticmethod
+    def _str_tuple(node: Optional[ast.AST]) -> Tuple[str, ...]:
+        if node is None:
+            return ()
+        try:
+            v = ast.literal_eval(node)
+        except (ValueError, SyntaxError):
+            return ()
+        if isinstance(v, str):
+            return (v,)
+        if isinstance(v, (tuple, list)):
+            return tuple(x for x in v if isinstance(x, str))
+        return ()
+
+    def _wrapper_from_call(
+        self, call: ast.Call, scope: Optional[str], bound: Optional[str]
+    ) -> Optional[JitWrapper]:
+        callee = dotted(call.func)
+        kind = None
+        if callee in _JIT_CALLS:
+            kind = "jit"
+        elif callee in _PALLAS_CALLS:
+            kind = "pallas"
+        elif callee in _PARTIAL and call.args:
+            inner = dotted(call.args[0])
+            if inner in _JIT_CALLS:
+                # partial(jax.jit, static_argnums=...) used as a decorator
+                kw = {k.arg: k.value for k in call.keywords if k.arg}
+                return JitWrapper(
+                    target=None, bound_name=bound, line=call.lineno,
+                    static_argnums=self._int_tuple(kw.get("static_argnums")),
+                    static_argnames=self._str_tuple(kw.get("static_argnames")),
+                    donate_argnums=self._int_tuple(kw.get("donate_argnums")),
+                    donate_argnames=self._str_tuple(kw.get("donate_argnames")),
+                )
+            return None
+        if kind is None:
+            return None
+        target = self._resolve_func_ref(call.args[0], scope) if call.args else None
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        return JitWrapper(
+            target=target, bound_name=bound, line=call.lineno, kind=kind,
+            static_argnums=self._int_tuple(kw.get("static_argnums")),
+            static_argnames=self._str_tuple(kw.get("static_argnames")),
+            donate_argnums=self._int_tuple(kw.get("donate_argnums")),
+            donate_argnames=self._str_tuple(kw.get("donate_argnames")),
+        )
+
+    def _collect_wrappers(self) -> None:
+        # Decorated defs: @jax.jit, @partial(jax.jit, ...), @pl.pallas_call(...)
+        for q, info in self.funcs.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                w = None
+                name = dotted(dec)
+                if name in _JIT_CALLS:
+                    w = JitWrapper(target=q, bound_name=q, line=dec.lineno)
+                elif isinstance(dec, ast.Call):
+                    w = self._wrapper_from_call(dec, None, q)
+                    if w is not None:
+                        w.target = q
+                if w is not None:
+                    self.wrappers.append(w)
+
+        # Call-expression wrappers anywhere: x = jax.jit(f, ...) /
+        # self._f_jit = jax.jit(f) / res = pl.pallas_call(kernel, ...)(args)
+        line_scope = {}
+        for q, info in self.funcs.items():
+            end = getattr(info.node, "end_lineno", info.node.lineno)
+            for ln in range(info.node.lineno, end + 1):
+                line_scope[ln] = q
+
+        class V(ast.NodeVisitor):
+            def __init__(v):
+                v.out: List[JitWrapper] = []
+
+            def visit_Assign(v, node: ast.Assign):
+                if isinstance(node.value, ast.Call):
+                    scope = line_scope.get(node.lineno)
+                    bound = dotted(node.targets[0]) if len(node.targets) == 1 else None
+                    w = self._wrapper_from_call(node.value, scope, bound)
+                    if w is not None:
+                        v.out.append(w)
+                        return
+                v.generic_visit(node)
+
+            def visit_Call(v, node: ast.Call):
+                scope = line_scope.get(node.lineno)
+                w = self._wrapper_from_call(node, scope, None)
+                if w is not None:
+                    v.out.append(w)
+                v.generic_visit(node)
+
+        vis = V()
+        vis.visit(self.mod.tree)
+        # De-dup (an Assign's Call is visited twice).
+        seen = set()
+        for w in vis.out + self.wrappers:
+            k = (w.line, w.bound_name, w.target)
+            if k not in seen:
+                seen.add(k)
+        dedup: List[JitWrapper] = []
+        seen = set()
+        for w in self.wrappers + vis.out:
+            k = (w.line, w.bound_name, w.target, w.kind)
+            if k not in seen:
+                seen.add(k)
+                dedup.append(w)
+        self.wrappers = dedup
+
+    def _collect_calls(self) -> None:
+        for q, info in self.funcs.items():
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    callee = self._resolve_func_ref(node.func, q)
+                    if callee and callee != q:
+                        info.calls.add(callee)
+                # Function references passed as arguments (e.g.
+                # jax.lax.fori_loop(0, n, body, init)) keep the body
+                # reachable too.
+                if isinstance(node, ast.Call):
+                    for arg in node.args:
+                        if isinstance(arg, (ast.Name, ast.Attribute)):
+                            ref = self._resolve_func_ref(arg, q)
+                            if ref and ref != q:
+                                info.calls.add(ref)
+
+    # -- queries -------------------------------------------------------------
+    def jit_roots(self) -> Set[str]:
+        return {w.target for w in self.wrappers if w.target}
+
+    def reachable_from_jit(self) -> Set[str]:
+        """Qualnames reachable (BFS over module-local call edges) from any
+        jit/pallas root — the set whose bodies trace into executables."""
+        roots = self.jit_roots()
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            q = stack.pop()
+            if q in seen or q not in self.funcs:
+                continue
+            seen.add(q)
+            stack.extend(self.funcs[q].calls - seen)
+        return seen
+
+    def bound_wrappers(self) -> Dict[str, JitWrapper]:
+        """{bound name: wrapper} for wrappers assigned to a name/attr —
+        jitted call sites are calls through these names."""
+        return {w.bound_name: w for w in self.wrappers if w.bound_name}

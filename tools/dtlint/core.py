@@ -1,0 +1,316 @@
+"""dtlint core: source index, findings, suppressions, baseline.
+
+The repo's performance invariants (0 post-warmup compiles, 1 blocking sync
+per decode step, every counter registered + pinned) are dynamic properties
+enforced by a handful of tests that exercise specific paths. dtlint turns
+them into *static* properties of the whole tree: every rule is a pure
+``ast`` pass (no new deps, no JAX import, runs in seconds on CPU-less CI).
+
+Vocabulary:
+
+- A **Finding** is one violation: (rule, file, line, qualname, message,
+  key). ``key`` is a short stable token (usually the offending call or
+  metric name) so baseline entries survive line-number drift.
+- A **suppression** is an inline ``# dtlint: disable=RULE[,RULE]`` comment
+  on the flagged line, or a file-wide ``# dtlint: disable-file=RULE`` in
+  the first 10 lines. Suppressions are for code where the rule is wrong;
+  deliberate *exceptions to the invariant* belong in the baseline with a
+  reason.
+- The **baseline** (``dtlint_baseline.json``) lists reviewed, deliberate
+  findings. Every entry must carry a ``reason`` string and must still
+  match a live finding — stale entries are themselves an error, so the
+  baseline can only shrink or be consciously re-reviewed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*dtlint:\s*disable=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*dtlint:\s*disable-file=([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # repo-relative, forward slashes
+    line: int
+    qualname: str      # enclosing Class.func (or "<module>")
+    message: str
+    key: str           # stable short token for baseline matching
+
+    def ident(self) -> Tuple[str, str, str, str]:
+        """Baseline identity: line numbers drift, these don't."""
+        return (self.rule, self.file, self.qualname, self.key)
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "file": self.file, "line": self.line,
+            "qualname": self.qualname, "key": self.key, "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} [{self.qualname}] {self.message}"
+
+
+class SourceModule:
+    """One parsed Python file plus its suppression table."""
+
+    def __init__(self, root: str, relpath: str) -> None:
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = os.path.join(root, relpath)
+        with open(self.path, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.relpath)
+        self._line_suppress: Dict[int, set] = {}
+        self._file_suppress: set = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self._line_suppress[i] = rules
+            if i <= 10:
+                m = _SUPPRESS_FILE_RE.search(line)
+                if m:
+                    self._file_suppress |= {r.strip() for r in m.group(1).split(",")}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppress:
+            return True
+        return rule in self._line_suppress.get(line, set())
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+@dataclass
+class LintConfig:
+    """Anchors the cross-file rules. Tests point these at fixtures."""
+
+    root: str
+    paths: Tuple[str, ...] = ("dynamo_tpu",)
+    # MET001 anchors: the module holding COUNTER_KEYS/GAUGE_KEYS and the
+    # Grafana dashboard whose exprs must pin them.
+    aggregator_path: str = "dynamo_tpu/metrics_aggregator.py"
+    grafana_path: str = "deploy/grafana/dynamo_tpu_serving.json"
+    # SYNC001 anchor: hot-path spec + the sanctioned sync allowlist.
+    sync_allowlist_path: str = "tools/dtlint/sync_allowlist.json"
+    # THR001: (file-suffix, qualname) pairs designated as extra thread entry
+    # points beyond auto-detected threading.Thread targets.
+    thread_entries: Tuple[Tuple[str, str], ...] = (
+        # Engine stats handler runs on the event loop while the scheduler
+        # steps on a worker thread; these scrape-side entry points share
+        # state with the step path.
+        ("dynamo_tpu/engine/engine.py", "TpuEngine.stats_handler"),
+        ("dynamo_tpu/engine/scheduler.py", "Scheduler.metrics"),
+        ("dynamo_tpu/engine/scheduler.py", "Scheduler.kv_gauges"),
+        ("dynamo_tpu/engine/scheduler.py", "Scheduler.debug_state"),
+        ("dynamo_tpu/runtime/telemetry.py", "StallWatchdog.check"),
+    )
+    # MET001: functions whose dict keys are worker-scrape wire keys, and
+    # path fragments OUTSIDE the worker-scrape plane (router/frontend/
+    # planner metrics have their own registries and conventions).
+    met001_emitters: Tuple[str, ...] = (
+        "to_wire", "to_stats", "stats_handler", "kv_gauges", "stats",
+        "_stats_loop",
+    )
+    met001_exclude: Tuple[str, ...] = (
+        "llm/kv_router", "llm/http", "planner/", "deploy/", "runtime/metrics.py",
+    )
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+
+class ProjectIndex:
+    """All parsed modules under config.paths, plus lazy per-rule caches."""
+
+    def __init__(self, config: LintConfig) -> None:
+        self.config = config
+        self.modules: List[SourceModule] = []
+        seen = set()
+        for p in config.paths:
+            ap = config.abspath(p)
+            if os.path.isfile(ap) and p.endswith(".py"):
+                if p not in seen:
+                    seen.add(p)
+                    self.modules.append(SourceModule(config.root, p))
+                continue
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(os.path.join(dirpath, fn), config.root)
+                    rel = rel.replace(os.sep, "/")
+                    if rel not in seen:
+                        seen.add(rel)
+                        self.modules.append(SourceModule(config.root, rel))
+
+    def module(self, relpath: str) -> Optional[SourceModule]:
+        for m in self.modules:
+            if m.relpath == relpath or m.relpath.endswith("/" + relpath):
+                return m
+        return None
+
+
+# --- rule registry ----------------------------------------------------------
+
+RULES: Dict[str, Callable[[ProjectIndex], List[Finding]]] = {}
+RULE_DOCS: Dict[str, str] = {}
+
+
+def rule(name: str, doc: str):
+    def deco(fn):
+        RULES[name] = fn
+        RULE_DOCS[name] = doc
+        return fn
+    return deco
+
+
+# --- baseline ---------------------------------------------------------------
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    for e in entries:
+        for req in ("rule", "file", "qualname", "key", "reason"):
+            if not e.get(req):
+                raise BaselineError(f"baseline entry missing '{req}': {e}")
+    return entries
+
+
+def apply_baseline(
+    findings: List[Finding], entries: List[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """(unbaselined findings, stale entries). An entry absorbs at most
+    one finding per (rule,file,qualname,key) identity — but identical
+    identities (e.g. two device_get sites in one function) collapse onto
+    one entry, so matching is by identity set, not 1:1 counting."""
+    idents = {(e["rule"], e["file"], e["qualname"], e["key"]): e for e in entries}
+    live = set()
+    out = []
+    for f in findings:
+        if f.ident() in idents:
+            live.add(f.ident())
+        else:
+            out.append(f)
+    stale = [e for ident, e in idents.items() if ident not in live]
+    return out, stale
+
+
+# --- shared AST helpers -----------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.Module) -> Iterable[Tuple[str, ast.AST]]:
+    """Yield (qualname, funcdef) for every function/method, including
+    nested ones ('outer.<locals>.inner' collapses to 'outer.inner')."""
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def enclosing_map(tree: ast.Module) -> Dict[int, str]:
+    """{line: qualname} for every line covered by a function body (innermost
+    wins) — lets rules attribute a Finding to its enclosing function."""
+    spans: List[Tuple[int, int, str]] = []
+    for q, fn in iter_functions(tree):
+        end = getattr(fn, "end_lineno", fn.lineno)
+        spans.append((fn.lineno, end, q))
+    spans.sort(key=lambda s: (s[0], -s[1]))
+    out: Dict[int, str] = {}
+    for lo, hi, q in spans:
+        for ln in range(lo, hi + 1):
+            out[ln] = q  # later (inner) spans overwrite outer ones
+    return out
+
+
+def qualname_at(line_map: Dict[int, str], line: int) -> str:
+    return line_map.get(line, "<module>")
+
+
+def module_constants(tree: ast.Module) -> Dict[str, object]:
+    """Module-level NAME = <literal> bindings (tuples/lists of str, str,
+    int) — used to expand f-string metric keys and spot mutable globals."""
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            try:
+                out[node.targets[0].id] = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                pass
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    stale_baseline: List[dict] = field(default_factory=list)
+    baseline_size: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+
+def run_lint(
+    config: LintConfig,
+    rules: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    # Import registers the rules (they live in sibling modules).
+    from tools.dtlint import rules_jit, rules_metrics, rules_sync, rules_threads  # noqa: F401
+
+    index = ProjectIndex(config)
+    names = list(rules) if rules else sorted(RULES)
+    findings: List[Finding] = []
+    for name in names:
+        if name not in RULES:
+            raise ValueError(f"unknown rule {name!r}; have {sorted(RULES)}")
+        findings.extend(RULES[name](index))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    stale: List[dict] = []
+    size = 0
+    if baseline_path:
+        entries = load_baseline(baseline_path)
+        size = len(entries)
+        if rules:
+            entries = [e for e in entries if e["rule"] in set(names)]
+        findings, stale = apply_baseline(findings, entries)
+    return LintResult(findings=findings, stale_baseline=stale, baseline_size=size)

@@ -1,0 +1,355 @@
+"""JIT001 / JIT002 / DON001 — jit-body purity, recompile risk, donation.
+
+These protect the two invariants the repo's perf record hangs on:
+
+- **0 post-warmup compiles** (PR 2's compile tracker made it observable;
+  warmup() precompiles the serving key space). JIT002 catches the static
+  shape-leak pattern that created mid-traffic compiles twice in this
+  repo's history (scheduler width variants, wave-admission shapes).
+- **Traced bodies are pure.** Host calls inside a jit/pallas body run at
+  TRACE time only — a ``time.monotonic()`` or ``random.random()`` inside
+  a kernel silently bakes one stale value into the executable; a
+  ``print``/``logging`` call fires once per compile, not per step
+  (debuggers chase ghosts). JIT001 flags them via a module-local call
+  graph from every ``jax.jit``/``pallas_call`` root.
+- **KV/cache buffers update in place.** A jit wrapper that rewrites a
+  cache buffer without donating it doubles peak HBM for the step and
+  copies the whole pool (DON001); donating an arg the caller still reads
+  is a use-after-free on device (DON001's inverse).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.dtlint.callgraph import ModuleGraph
+from tools.dtlint.core import (
+    Finding, ProjectIndex, dotted, enclosing_map, qualname_at, rule,
+)
+
+_HOST_CALL_PREFIXES = (
+    "time.", "random.", "_random.", "np.random.", "numpy.random.",
+    "logging.", "logger.", "datetime.",
+)
+_HOST_CALL_EXACT = {"print", "input", "open"}
+
+_MUTABLE_FACTORIES = {"list", "dict", "set", "deque", "defaultdict", "Counter", "OrderedDict"}
+
+# Value-laundering helpers that turn a raw length into a bucketed rung —
+# ints derived through these are compile-stable by construction (the whole
+# point of the bucket-rung scheme).
+_BUCKET_HELPERS = {
+    "next_bucket", "width_bucket", "width_rungs", "_width_bucket",
+    "_chunk_budget", "_wave_s_cap", "min", "max",
+}
+
+_KV_PARAM_HINTS = ("cache", "kv")
+_KV_PARAM_EXACT = {"k", "v", "c", "cache_k", "cache_v", "blocks"}
+
+
+def _mutable_globals(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(v, ast.ListComp) or isinstance(v, ast.DictComp) or isinstance(v, ast.SetComp)
+            ):
+                out.add(node.targets[0].id)
+            elif isinstance(v, ast.Call) and dotted(v.func).split(".")[-1] in _MUTABLE_FACTORIES:
+                out.add(node.targets[0].id)
+    return out
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside a function: params, assignments, for-targets,
+    withitems, comprehension targets, imports."""
+    names: Set[str] = set()
+    a = fn.args
+    for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        names.add(p.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store,)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+@rule("JIT001", "host impurity (time/random/logging/print, mutable-global reads) inside jit/pallas bodies")
+def jit001(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.modules:
+        graph = ModuleGraph(mod)
+        reach = graph.reachable_from_jit()
+        if not reach:
+            continue
+        mut_globals = _mutable_globals(mod.tree)
+        for q in sorted(reach):
+            info = graph.funcs.get(q)
+            if info is None:
+                continue
+            fn = info.node
+            locals_ = _local_bindings(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    hit = name in _HOST_CALL_EXACT or any(
+                        name.startswith(p) for p in _HOST_CALL_PREFIXES
+                    )
+                    if hit and not mod.suppressed("JIT001", node.lineno):
+                        findings.append(Finding(
+                            "JIT001", mod.relpath, node.lineno, q,
+                            f"host-impure call {name}() inside jit/pallas-reachable body "
+                            f"(runs at trace time, not per step)",
+                            key=f"call:{name}",
+                        ))
+                elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    if node.id in mut_globals and node.id not in locals_:
+                        if not mod.suppressed("JIT001", node.lineno):
+                            findings.append(Finding(
+                                "JIT001", mod.relpath, node.lineno, q,
+                                f"read of mutable module global '{node.id}' inside "
+                                f"jit/pallas-reachable body (value frozen at trace time)",
+                                key=f"global:{node.id}",
+                            ))
+    return findings
+
+
+def _shape_scalars(fn: ast.AST) -> Set[str]:
+    """Names holding raw Python ints derived from len()/shape — passing one
+    straight into a jitted callable keys a fresh executable per value."""
+    tainted: Set[str] = set()
+
+    def is_shapey(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            name = dotted(expr.func)
+            if name == "len":
+                return True
+            if name.split(".")[-1] in _BUCKET_HELPERS:
+                return False  # laundered through a bucket rung
+            return False
+        if isinstance(expr, ast.Subscript) and isinstance(expr.value, ast.Attribute):
+            if expr.value.attr == "shape":
+                return True
+        if isinstance(expr, ast.BinOp):
+            return is_shapey(expr.left) or is_shapey(expr.right)
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        return False
+
+    # Two passes so x = len(a); y = x + 1 taints y.
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                if is_shapey(node.value):
+                    tainted.add(node.targets[0].id)
+                elif node.targets[0].id in tainted:
+                    # reassigned to something clean (e.g. a bucket helper)
+                    tainted.discard(node.targets[0].id)
+    return tainted
+
+
+def _is_jitted_callee(name: str, bound: Dict[str, "object"]) -> bool:
+    if name in bound:
+        return True
+    tail = name.split(".")[-1]
+    return tail.endswith("_jit")
+
+
+@rule("JIT002", "recompile risk: raw shape scalars into jitted calls; unstable static_argnums/argnames")
+def jit002(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.modules:
+        graph = ModuleGraph(mod)
+        bound = graph.bound_wrappers()
+
+        # (a) static_argnums/static_argnames pointing at hashable-unstable
+        # params (mutable defaults / container annotations).
+        for w in graph.wrappers:
+            if w.target is None or (not w.static_argnums and not w.static_argnames):
+                continue
+            info = graph.funcs.get(w.target)
+            if info is None:
+                continue
+            fn = info.node
+            params = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+            defaults = fn.args.defaults
+            default_by_param = {}
+            if defaults:
+                for p, d in zip(params[-len(defaults):], defaults):
+                    default_by_param[p] = d
+            static_params = set(w.static_argnames)
+            for i in w.static_argnums:
+                if 0 <= i < len(params):
+                    static_params.add(params[i])
+            for pname in sorted(static_params):
+                ann = None
+                for p in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+                    if p.arg == pname:
+                        ann = p.annotation
+                d = default_by_param.get(pname)
+                unstable = isinstance(d, (ast.List, ast.Dict, ast.Set))
+                if ann is not None:
+                    aname = dotted(ann) or (
+                        dotted(ann.value) if isinstance(ann, ast.Subscript) else ""
+                    )
+                    if aname.split(".")[-1].lower() in ("list", "dict", "set"):
+                        unstable = True
+                if unstable and not mod.suppressed("JIT002", w.line):
+                    findings.append(Finding(
+                        "JIT002", mod.relpath, w.line, w.target,
+                        f"static arg '{pname}' of jitted {w.target} is hashable-unstable "
+                        f"(list/dict/set) — every call retraces or TypeErrors",
+                        key=f"static:{pname}",
+                    ))
+
+        # (b) call sites handing raw shape-derived Python scalars (or bare
+        # len()) to a jitted callable — each distinct value compiles a new
+        # executable; route through the bucket-rung helpers instead.
+        line_map = enclosing_map(mod.tree)
+        for q, info in graph.funcs.items():
+            fn = info.node
+            tainted = _shape_scalars(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted(node.func)
+                if not callee or not _is_jitted_callee(callee, bound):
+                    continue
+                # A raw Python scalar only keys a fresh executable in a
+                # STATIC position (traced positions key on shape+dtype).
+                # With the wrapper resolved, restrict to its static
+                # argnums; unresolved `*_jit` callees stay conservative.
+                w = bound.get(callee)
+                static_idx = set(w.static_argnums) if w is not None else None
+                for i, arg in enumerate(node.args):
+                    if static_idx is not None and i not in static_idx:
+                        continue
+                    bad = None
+                    if isinstance(arg, ast.Call) and dotted(arg.func) == "len":
+                        bad = "len(...)"
+                    elif isinstance(arg, ast.Name) and arg.id in tainted:
+                        bad = arg.id
+                    if bad and not mod.suppressed("JIT002", node.lineno):
+                        findings.append(Finding(
+                            "JIT002", mod.relpath, node.lineno,
+                            qualname_at(line_map, node.lineno),
+                            f"raw shape scalar {bad!r} passed to jitted {callee}() — "
+                            f"compiles one executable per distinct value; bucket it "
+                            f"(next_bucket/width_bucket) or pass jnp.int32(...)",
+                            key=f"shape:{bad}",
+                        ))
+    return findings
+
+
+def _kv_param(name: str) -> bool:
+    low = name.lower()
+    return name in _KV_PARAM_EXACT or any(h in low for h in _KV_PARAM_HINTS)
+
+
+@rule("DON001", "KV/cache-writing jit wrappers without donate_argnums; donated args reused by the caller")
+def don001(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.modules:
+        graph = ModuleGraph(mod)
+        line_map = enclosing_map(mod.tree)
+
+        # (a) wrapper writes a KV/cache param but doesn't donate it.
+        for w in graph.wrappers:
+            if w.target is None or w.kind != "jit":
+                continue
+            info = graph.funcs.get(w.target)
+            if info is None:
+                continue
+            fn = info.node
+            params = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+            donated = set(w.donate_argnames)
+            for i in w.donate_argnums:
+                if 0 <= i < len(params):
+                    donated.add(params[i])
+            for idx, pname in enumerate(params):
+                if not _kv_param(pname) or pname in donated:
+                    continue
+                # `p.at[...]` and pytree-field writes `p.q.at[...]` both
+                # count: the buffer being functionally updated is (part of)
+                # the parameter.
+                writes = any(
+                    isinstance(n, ast.Attribute) and n.attr == "at"
+                    and dotted(n.value).split(".")[0] == pname
+                    for n in ast.walk(fn)
+                )
+                if writes and not mod.suppressed("DON001", w.line):
+                    findings.append(Finding(
+                        "DON001", mod.relpath, w.line, w.target,
+                        f"jitted {w.target} writes cache param '{pname}' "
+                        f"(.at[...] update) without donate_argnums — the step "
+                        f"double-buffers the whole pool in HBM",
+                        key=f"nodonate:{pname}",
+                    ))
+
+        # (b) caller reuses an arg it donated (device use-after-free).
+        donating = {
+            w.bound_name: w for w in graph.wrappers
+            if w.bound_name and w.donate_argnums
+        }
+        if not donating:
+            continue
+        for q, info in graph.funcs.items():
+            fn = info.node
+            # Line spans of every jit-wrapper call in this function: a load
+            # that is itself an argument to a (re-)dispatch is the normal
+            # donate→reassign step pattern (and mutually exclusive branches
+            # each carry their own dispatch), not a stale read.
+            jit_call_spans = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    cname = dotted(n.func)
+                    if cname and (cname in donating or cname.split(".")[-1].endswith("_jit")):
+                        jit_call_spans.append((n.lineno, getattr(n, "end_lineno", n.lineno)))
+
+            def in_jit_call(line: int) -> bool:
+                return any(lo <= line <= hi for lo, hi in jit_call_spans)
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                w = donating.get(dotted(node.func))
+                if w is None:
+                    continue
+                call_end = getattr(node, "end_lineno", node.lineno)
+                for i in w.donate_argnums:
+                    if i >= len(node.args):
+                        continue
+                    arg_name = dotted(node.args[i])
+                    if not arg_name:
+                        continue
+                    first_store = None
+                    first_load = None
+                    for n in ast.walk(fn):
+                        nm = dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) else ""
+                        if nm != arg_name:
+                            continue
+                        ctx = getattr(n, "ctx", None)
+                        if isinstance(ctx, ast.Store) and n.lineno >= node.lineno:
+                            if first_store is None or n.lineno < first_store:
+                                first_store = n.lineno
+                        elif isinstance(ctx, ast.Load) and n.lineno > call_end and not in_jit_call(n.lineno):
+                            if first_load is None or n.lineno < first_load:
+                                first_load = n.lineno
+                    if first_load is not None and (first_store is None or first_load < first_store):
+                        if not mod.suppressed("DON001", first_load):
+                            findings.append(Finding(
+                                "DON001", mod.relpath, first_load,
+                                qualname_at(line_map, first_load),
+                                f"'{arg_name}' is read after being donated to "
+                                f"{dotted(node.func)}() at line {node.lineno} — "
+                                f"donated buffers are invalid after the call",
+                                key=f"reuse:{arg_name}",
+                            ))
+    return findings

@@ -1,0 +1,217 @@
+"""MET001 — cross-file metrics drift (code ↔ aggregator key lists ↔ Grafana).
+
+A counter the scheduler increments is worthless if the aggregator drops it
+at the scrape (not in ``COUNTER_KEYS``) or no dashboard panel pins it —
+and a pinned panel over a key nobody emits rots into permanent "no data"
+(the dashboard failure mode PR 2 fixed once already). The dynamic half of
+``test_metrics_hygiene.py`` proves keys *render*; this rule closes the
+static triangle over the whole tree:
+
+  (a) every counter key emitted on the worker-scrape wire (``to_wire``/
+      ``to_stats``/``stats_handler``/``kv_gauges``/``stats`` dict keys
+      ending ``_total``) is registered in ``COUNTER_KEYS``;
+  (b) every registered COUNTER/GAUGE key is emitted somewhere (f-string
+      keys like ``step_{phase}_steps_total`` match as wildcards);
+  (c) every registered key is pinned by at least one Grafana panel expr;
+  (d) every ``dynamo_component_worker_*`` family a panel references is a
+      registered key.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.dtlint.core import Finding, ProjectIndex, iter_functions, rule
+
+
+def _key_list_lines(tree: ast.Module, list_name: str) -> Dict[str, int]:
+    """{key: lineno} for the elements of a module-level tuple constant."""
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == list_name
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            out = {}
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out[el.value] = el.lineno
+            return out
+    return {}
+
+
+def _fstring_pattern(node: ast.JoinedStr) -> str:
+    """Regex for an f-string key: literal parts verbatim, each formatted
+    value becomes ``\\w+``."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(re.escape(str(v.value)))
+        else:
+            parts.append(r"\w+")
+    return "^" + "".join(parts) + "$"
+
+
+def _dataclass_fields(tree: ast.Module, cls_name: str) -> List[Tuple[str, int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            out = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                    out.append((stmt.target.id, stmt.lineno))
+            return out
+    return []
+
+
+def collect_wire_keys(index: ProjectIndex):
+    """(literal_keys {key: (file, line)}, wildcard_patterns [(regex, file, line)])
+    from every emitter function in the scanned tree."""
+    cfg = index.config
+    literals: Dict[str, Tuple[str, int]] = {}
+    wildcards: List[Tuple[str, str, int]] = []
+    for mod in index.modules:
+        if any(x in mod.relpath for x in cfg.met001_exclude):
+            continue
+        for q, fn in iter_functions(mod.tree):
+            if q.split(".")[-1] not in cfg.met001_emitters:
+                continue
+
+            def note_key(knode: ast.AST) -> None:
+                if isinstance(knode, ast.Constant) and isinstance(knode.value, str):
+                    literals.setdefault(knode.value, (mod.relpath, knode.lineno))
+                elif isinstance(knode, ast.JoinedStr):
+                    wildcards.append((_fstring_pattern(knode), mod.relpath, knode.lineno))
+
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Dict):
+                    for k in node.keys:
+                        if k is not None:
+                            note_key(k)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            note_key(tgt.slice)
+                elif isinstance(node, ast.Call):
+                    # self.__dict__.copy() in to_wire ⇒ the dataclass's own
+                    # fields are the wire keys (ForwardPassMetrics pattern).
+                    src = ast.unparse(node)
+                    if "self.__dict__" in src and "." in q:
+                        cls = q.rsplit(".", 2)[-2]
+                        for fname, fline in _dataclass_fields(mod.tree, cls):
+                            literals.setdefault(fname, (mod.relpath, fline))
+    return literals, wildcards
+
+
+def _grafana_worker_keys(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        dash = json.load(f)
+    exprs: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if isinstance(o.get("expr"), str):
+                exprs.append(o["expr"])
+            for v in o.values():
+                walk(v)
+        elif isinstance(o, list):
+            for v in o:
+                walk(v)
+
+    walk(dash)
+    keys: Set[str] = set()
+    for e in exprs:
+        for m in re.findall(r"dynamo_component_worker_([a-zA-Z0-9_]+)", e):
+            keys.add(re.sub(r"_(bucket|sum|count)$", "", m))
+    return keys
+
+
+@rule("MET001", "metrics drift: wire keys ↔ aggregator COUNTER_KEYS/GAUGE_KEYS ↔ Grafana panel exprs")
+def met001(index: ProjectIndex) -> List[Finding]:
+    cfg = index.config
+    agg = index.module(cfg.aggregator_path)
+    if agg is None:
+        try:
+            from tools.dtlint.core import SourceModule
+
+            agg = SourceModule(cfg.root, cfg.aggregator_path)
+        except OSError:
+            return []
+    counter_lines = _key_list_lines(agg.tree, "COUNTER_KEYS")
+    gauge_lines = _key_list_lines(agg.tree, "GAUGE_KEYS")
+    counters = set(counter_lines)
+    gauges = set(gauge_lines)
+    registered = counters | gauges
+
+    literals, wildcards = collect_wire_keys(index)
+    wc_res = [(re.compile(p), f, ln) for p, f, ln in wildcards]
+
+    def emitted(key: str) -> bool:
+        if key in literals:
+            return True
+        return any(r.match(key) for r, _, _ in wc_res)
+
+    grafana_path = cfg.abspath(cfg.grafana_path)
+    pinned = _grafana_worker_keys(grafana_path)
+    grafana_rel = cfg.grafana_path.replace(os.sep, "/")
+
+    findings: List[Finding] = []
+
+    # (a) counters on the wire but not registered.
+    for key, (file, line) in sorted(literals.items()):
+        if not key.endswith("_total") or key in registered:
+            continue
+        mod = index.module(file)
+        if mod is not None and mod.suppressed("MET001", line):
+            continue
+        findings.append(Finding(
+            "MET001", file, line, "<wire>",
+            f"counter '{key}' is emitted on the worker-scrape wire but not "
+            f"registered in metrics_aggregator COUNTER_KEYS — the aggregator "
+            f"drops it at every scrape",
+            key=f"unregistered:{key}",
+        ))
+
+    agg_rel = agg.relpath
+    for key in sorted(registered):
+        line = counter_lines.get(key) or gauge_lines.get(key) or 1
+        if agg.suppressed("MET001", line):
+            continue
+        # (b) registered but nothing emits it.
+        if not emitted(key):
+            findings.append(Finding(
+                "MET001", agg_rel, line, "<keys>",
+                f"'{key}' is registered in the aggregator key lists but no "
+                f"to_wire/to_stats/stats_handler emits it — dead key or "
+                f"renamed emitter",
+                key=f"unemitted:{key}",
+            ))
+        # (c) registered but no Grafana panel pins it.
+        if pinned and key not in pinned:
+            findings.append(Finding(
+                "MET001", agg_rel, line, "<keys>",
+                f"'{key}' is registered but no Grafana panel expr references "
+                f"dynamo_component_worker_{key} — unpinned metrics rot",
+                key=f"unpinned:{key}",
+            ))
+
+    # (d) dashboard references an unknown worker key.
+    for key in sorted(pinned):
+        base = key[:-len("_total")] if key.endswith("_total") else key
+        if key in registered or base in registered:
+            continue
+        findings.append(Finding(
+            "MET001", grafana_rel, 1, "<grafana>",
+            f"dashboard references dynamo_component_worker_{key} but '{key}' "
+            f"is in neither COUNTER_KEYS nor GAUGE_KEYS — the panel can never "
+            f"show data",
+            key=f"unknown:{key}",
+        ))
+    return findings

@@ -1,0 +1,208 @@
+"""SYNC001 — blocking device syncs inside scheduler/engine hot paths.
+
+PR 4's zero-bubble pipeline rests on ONE invariant: a steady-state decode
+step performs exactly one blocking host↔device sync (the previous step's
+sampled-token readback). Every extra ``np.asarray``/``float()``/
+``.item()``/``jax.device_get``/``.block_until_ready()`` on a device value
+re-serializes the host against the device and reopens the bubble — and
+the regression is invisible until a bench round measures the gap.
+
+The rule scopes to the hot-path functions named in
+``tools/dtlint/sync_allowlist.json`` and classifies every local name as
+HOST / DEVICE / UNKNOWN with a small per-function taint pass:
+
+- DEVICE: results of ``jnp.*``/``jax.*`` calls (except ``device_get``),
+  calls through ``*_jit`` wrappers, params annotated ``jax.Array``.
+- HOST: ``np.*`` results, literals/displays/comprehensions, ``len``,
+  ``time.*``, ``jax.device_get`` results, params annotated with host
+  types (int/float/bool/str/List/...).
+- UNKNOWN: everything else (``self._pipe["sampled"]``, helper returns).
+
+``block_until_ready``/``device_get`` always flag; ``np.asarray``/
+``np.array`` flag on DEVICE **and UNKNOWN** arguments (guilty until
+proven host — in these few functions a wrongly-accused host copy is a
+one-line allowlist entry, a missed device sync is a perf regression);
+``float``/``int``/``.item``/``.tolist`` flag on DEVICE only.
+
+The allowlist file names each *sanctioned* sync — (file, func, call) with
+a role and a reason. The ``role: "per_step"`` entries are the statically
+declared 1-sync-per-step budget; ``bench.py`` cross-validates them
+against the measured blocking-sync count (static and dynamic views of
+the same invariant must agree).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from tools.dtlint.core import Finding, ProjectIndex, dotted, iter_functions, rule
+
+HOST = "host"
+DEVICE = "device"
+UNKNOWN = "unknown"
+
+_ALWAYS_SYNC = {"block_until_ready"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_COPYING = {"np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray"}
+_NARROWING = {"float", "int", "bool"}
+_NARROWING_METHODS = {"item", "tolist"}
+
+_HOST_ANN = {"int", "float", "bool", "str", "bytes", "list", "dict", "set",
+             "tuple", "optional", "sequence", "iterable", "callable"}
+_DEVICE_ANN_HINTS = ("jax.array", "jnp.ndarray", "jax.numpy", "array")
+
+
+def load_sync_config(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"hot_paths": {}, "allowed_syncs": []}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _classify_call(call: ast.Call) -> str:
+    name = dotted(call.func)
+    if not name:
+        return UNKNOWN
+    if name in _DEVICE_GET or name in _COPYING or name.startswith("np."):
+        return HOST
+    if name in ("len", "range", "sum", "min", "max", "sorted", "list", "tuple",
+                "dict", "set", "zip", "enumerate", "round", "abs"):
+        return HOST
+    if name.startswith(("time.", "os.", "math.")):
+        return HOST
+    if name.startswith(("jnp.", "jax.", "lax.")):
+        return DEVICE
+    if name.split(".")[-1].endswith("_jit"):
+        return DEVICE
+    return UNKNOWN
+
+
+def _classify_expr(expr: ast.AST, taint: Dict[str, str]) -> str:
+    if isinstance(expr, ast.Constant):
+        return HOST
+    if isinstance(expr, (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp, ast.GeneratorExp, ast.JoinedStr)):
+        return HOST
+    if isinstance(expr, ast.Call):
+        return _classify_call(expr)
+    if isinstance(expr, ast.Name):
+        return taint.get(expr.id, UNKNOWN)
+    if isinstance(expr, ast.Subscript):
+        return _classify_expr(expr.value, taint)
+    if isinstance(expr, ast.BinOp):
+        l = _classify_expr(expr.left, taint)
+        r = _classify_expr(expr.right, taint)
+        if DEVICE in (l, r):
+            return DEVICE
+        if UNKNOWN in (l, r):
+            return UNKNOWN
+        return HOST
+    if isinstance(expr, ast.Compare) or isinstance(expr, ast.BoolOp):
+        return HOST
+    if isinstance(expr, ast.Attribute):
+        # self.cache.k and friends: resident device buffers.
+        base = dotted(expr)
+        if ".cache." in f".{base}." or base.endswith((".k", ".v")):
+            return DEVICE if base.startswith("self.") else UNKNOWN
+        return UNKNOWN
+    return UNKNOWN
+
+
+def _ann_class(ann: Optional[ast.AST]) -> str:
+    if ann is None:
+        return UNKNOWN
+    name = dotted(ann)
+    if not name and isinstance(ann, ast.Subscript):
+        name = dotted(ann.value)
+    if not name and isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value
+    low = (name or "").lower()
+    if any(h in low for h in _DEVICE_ANN_HINTS):
+        return DEVICE
+    if low.split(".")[-1] in _HOST_ANN:
+        return HOST
+    return UNKNOWN
+
+
+def _taint_function(fn: ast.AST) -> Dict[str, str]:
+    taint: Dict[str, str] = {}
+    a = fn.args
+    for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        taint[p.arg] = _ann_class(p.annotation)
+    # Two passes: later assignments may reference earlier names.
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                cls = _classify_expr(node.value, taint)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        taint[tgt.id] = cls
+                    elif isinstance(tgt, ast.Tuple):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                taint[el.id] = cls
+            elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                taint.setdefault(node.target.id, HOST)
+    return taint
+
+
+@rule("SYNC001", "blocking device syncs in hot-path functions outside the sanctioned allowlist")
+def sync001(index: ProjectIndex) -> List[Finding]:
+    cfg = load_sync_config(index.config.abspath(index.config.sync_allowlist_path))
+    hot_paths: Dict[str, List[str]] = cfg.get("hot_paths", {})
+    allowed = {
+        (e["file"], e["func"], e["call"]): e
+        for e in cfg.get("allowed_syncs", [])
+    }
+
+    findings: List[Finding] = []
+    for mod in index.modules:
+        hot_funcs = None
+        for file_key, funcs in hot_paths.items():
+            if mod.relpath == file_key or mod.relpath.endswith("/" + file_key):
+                hot_funcs = set(funcs)
+                break
+        if not hot_funcs:
+            continue
+        for q, fn in iter_functions(mod.tree):
+            if q not in hot_funcs:
+                continue
+            taint = _taint_function(fn)
+
+            def emit(line: int, call_name: str, detail: str) -> None:
+                if (mod.relpath, q, call_name) in allowed:
+                    return
+                if mod.suppressed("SYNC001", line):
+                    return
+                findings.append(Finding(
+                    "SYNC001", mod.relpath, line, q,
+                    f"blocking sync {call_name}({detail}) in hot path — the decode "
+                    f"step budget is 1 sync (sync_allowlist.json names it); "
+                    f"allowlist with a reason or move off the step path",
+                    key=f"sync:{call_name}",
+                ))
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                tail = name.split(".")[-1] if name else ""
+                if tail in _ALWAYS_SYNC:
+                    emit(node.lineno, "block_until_ready", dotted(node.func.value) if isinstance(node.func, ast.Attribute) else "")
+                elif name in _DEVICE_GET:
+                    emit(node.lineno, "jax.device_get", "")
+                elif name in _COPYING and node.args:
+                    cls = _classify_expr(node.args[0], taint)
+                    if cls in (DEVICE, UNKNOWN):
+                        canon = "np.array" if tail == "array" else "np.asarray"
+                        emit(node.lineno, canon, f"{ast.unparse(node.args[0])}: {cls}")
+                elif name in _NARROWING and node.args:
+                    if _classify_expr(node.args[0], taint) == DEVICE:
+                        emit(node.lineno, name, ast.unparse(node.args[0]))
+                elif tail in _NARROWING_METHODS and isinstance(node.func, ast.Attribute):
+                    if _classify_expr(node.func.value, taint) == DEVICE:
+                        emit(node.lineno, f".{tail}", ast.unparse(node.func.value))
+    return findings

@@ -1,0 +1,190 @@
+"""THR001 — fields written from ≥2 thread entry points without a lock or
+``# guarded-by:`` annotation.
+
+The serving plane is deliberately multi-threaded: the scheduler steps on a
+worker thread, the tracer exports on a writer thread, the stall watchdog
+probes from the poll cadence, and stats handlers read (and occasionally
+reset) state from the event loop. Plain-int last-write-wins races are an
+explicit, documented choice in some of these (flight_recorder's module
+docstring) — but that choice must be *visible at the write site*, not
+tribal knowledge, or the next PR adds a read-modify-write and loses
+increments silently.
+
+Mechanics, per class:
+
+- **Entry points** = methods passed as ``threading.Thread(target=...)``
+  within the class, plus the (file, qualname) pairs designated in
+  ``LintConfig.thread_entries``. Each entry's intra-class call closure is
+  one *domain*; everything else (minus ``__init__``) is the "main" domain.
+- An attribute assigned (``self.x = ...`` / ``self.x += ...``) in ≥2
+  domains is flagged unless every cross-domain write is under a
+  ``with self.<...lock...>:`` block, or the write line (or the attribute's
+  ``__init__`` line) carries a ``# guarded-by: <lock>`` annotation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dtlint.core import Finding, ProjectIndex, dotted, rule
+
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _thread_targets(cls: ast.ClassDef) -> Set[str]:
+    """Method names passed as Thread(target=self.X) anywhere in the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and dotted(node.func).endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = dotted(kw.value)
+                    if name.startswith("self."):
+                        out.add(name[len("self."):])
+    return out
+
+
+def _closure(methods: Dict[str, ast.AST], start: str) -> Set[str]:
+    seen: Set[str] = set()
+    stack = [start]
+    while stack:
+        m = stack.pop()
+        if m in seen or m not in methods:
+            continue
+        seen.add(m)
+        for node in ast.walk(methods[m]):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name.startswith("self."):
+                    stack.append(name[len("self."):])
+    return seen
+
+
+def _locked_lines(fn: ast.AST) -> Set[int]:
+    """Lines covered by a ``with self.<something lock-ish>:`` block."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = dotted(item.context_expr)
+                if name.startswith("self.") and "lock" in name.lower():
+                    end = getattr(node, "end_lineno", node.lineno)
+                    out.update(range(node.lineno, end + 1))
+    return out
+
+
+def _attr_writes(fn: ast.AST) -> List[Tuple[str, int]]:
+    """[(attr, line)] for every self.<attr> store in the function."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        tgt: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    tgt = t
+                    if isinstance(t.value, ast.Name) and t.value.id == "self":
+                        out.append((t.attr, t.lineno))
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Attribute):
+            t = node.target
+            if isinstance(t.value, ast.Name) and t.value.id == "self":
+                out.append((t.attr, t.lineno))
+    return out
+
+
+@rule("THR001", "fields written from ≥2 thread entry points without a lock or guarded-by annotation")
+def thr001(index: ProjectIndex) -> List[Finding]:
+    cfg = index.config
+    findings: List[Finding] = []
+    for mod in index.modules:
+        designated = {
+            q for f, q in cfg.thread_entries
+            if mod.relpath == f or mod.relpath.endswith("/" + f)
+        }
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = _class_methods(cls)
+            entries = _thread_targets(cls)
+            for q in designated:
+                c, _, m = q.rpartition(".")
+                if c == cls.name and m in methods:
+                    entries.add(m)
+            if not entries:
+                continue
+
+            domains: Dict[str, Set[str]] = {
+                e: _closure(methods, e) for e in sorted(entries)
+            }
+            # Main domain = closure of every method NOT already inside an
+            # entry closure. A shared helper (e.g. a drain called from both
+            # the scrape path and the step path) must count for BOTH
+            # domains — that cross-thread shared write is exactly the bug
+            # class this rule exists for.
+            entry_members = set().union(*domains.values()) if domains else set()
+            main_roots = {
+                m for m in methods
+                if m not in entry_members and m not in _INIT_METHODS
+            }
+            main: Set[str] = set()
+            for m in main_roots:
+                main |= _closure(methods, m)
+            main -= _INIT_METHODS
+            if main:
+                domains["<main>"] = main
+
+            # attr -> {domain: [(line, locked, annotated)]}
+            writes: Dict[str, Dict[str, List[Tuple[int, bool, bool]]]] = {}
+            init_annotated: Set[str] = set()
+            for m in _INIT_METHODS & set(methods):
+                for attr, line in _attr_writes(methods[m]):
+                    if "guarded-by:" in mod.line_text(line):
+                        init_annotated.add(attr)
+            for dom, members in domains.items():
+                for m in members:
+                    if m in _INIT_METHODS:
+                        continue
+                    fn = methods.get(m)
+                    if fn is None:
+                        continue
+                    locked = _locked_lines(fn)
+                    for attr, line in _attr_writes(fn):
+                        ann = "guarded-by:" in mod.line_text(line)
+                        writes.setdefault(attr, {}).setdefault(dom, []).append(
+                            (line, line in locked, ann)
+                        )
+
+            for attr, per_dom in sorted(writes.items()):
+                if len(per_dom) < 2 or attr in init_annotated:
+                    continue
+                unguarded = [
+                    (dom, line)
+                    for dom, sites in per_dom.items()
+                    for line, locked, ann in sites
+                    if not locked and not ann
+                ]
+                if len({dom for dom, _ in unguarded}) < 2:
+                    continue  # at most one domain writes without protection
+                # Report at the first unguarded non-main write (the thread
+                # side is where the annotation belongs).
+                dom, line = min(
+                    unguarded, key=lambda x: (x[0] == "<main>", x[1])
+                )
+                if mod.suppressed("THR001", line):
+                    continue
+                findings.append(Finding(
+                    "THR001", mod.relpath, line, f"{cls.name}.{attr}",
+                    f"'{attr}' is written from {len(per_dom)} thread domains "
+                    f"({', '.join(sorted(per_dom))}) without a lock — hold a "
+                    f"threading.Lock or annotate the write '# guarded-by: "
+                    f"<lock or single-writer argument>'",
+                    key=f"field:{attr}",
+                ))
+    return findings
