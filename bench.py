@@ -952,13 +952,15 @@ def bench_prefix_reuse():
 
 
 def bench_observability_overhead():
-    """Tracing + flight-recorder + telemetry cost at the scheduler (no
-    HTTP): steady decode throughput with tracing disabled vs fully sampled
-    (sample=1.0, JSONL export live). The digests, SLO judge, FLOPs/bytes
-    roofline model, and stall watchdog are LIVE in both phases — they are
-    always-on in production — so the section also proves the telemetry
-    plane's baseline cost rides inside the budget. The acceptance bar is
-    ≤2% token-throughput cost at the bench knee."""
+    """Tracing + flight-recorder + telemetry + INCIDENT-PLANE cost at the
+    scheduler (no HTTP): steady decode throughput with tracing disabled vs
+    fully sampled (sample=1.0, JSONL export live, trace ring + tail keep
+    armed). The digests, SLO judge, FLOPs/bytes roofline model, stall
+    watchdog, anomaly detector (polled at the production scrape cadence),
+    and the host stack sampler are LIVE in both phases — they are
+    always-on in production — so the section proves the whole diagnosis
+    plane rides inside the budget. The acceptance bar is ≤2%
+    token-throughput cost at the bench knee with 0 post-warmup compiles."""
     import tempfile
 
     import jax
@@ -968,6 +970,8 @@ def bench_observability_overhead():
     from dynamo_tpu.engine.models import llama
     from dynamo_tpu.engine.sampling import SamplingParams
     from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+    from dynamo_tpu.runtime.incidents import IncidentConfig, IncidentPlane
+    from dynamo_tpu.runtime.profiling import HostStackSampler
     from dynamo_tpu.runtime.telemetry import StallWatchdog
     from dynamo_tpu.runtime.tracing import configure_tracing, get_tracer
 
@@ -979,6 +983,11 @@ def bench_observability_overhead():
     # simply has no per-sequence trace tuples (the production off-path: one
     # None check per event site).
     trace_path = tempfile.mktemp(prefix="bench_trace_", suffix=".jsonl")
+    # Incident bundles land in the CI artifact dir when set (failures ship
+    # their own black box), else a scratch dir.
+    incident_dir = os.environ.get("DYN_INCIDENT_DIR") or tempfile.mkdtemp(
+        prefix="bench_incidents_"
+    )
 
     phase_counter = [0]
 
@@ -1004,7 +1013,11 @@ def bench_observability_overhead():
         return tokens / (time.perf_counter() - t0)
 
     try:
-        configure_tracing(path=trace_path, sample=1.0, service="bench")
+        # Full plane armed: ring black box + tail keep on top of the live
+        # JSONL export (tail is the worst case — every record also lands
+        # in the ring).
+        configure_tracing(path=trace_path, sample=1.0, service="bench",
+                          ring_size=256, tail=True)
         # SLO targets set so the per-finish judge actually runs; digests +
         # roofline model are unconditionally live in the scheduler.
         sched = Scheduler(cfg, params, SchedulerConfig(
@@ -1017,6 +1030,25 @@ def bench_observability_overhead():
             probe=lambda: (sched.has_work(), sched.flight.last_step_ts),
             stall_after_s=120.0,
         )
+        # Incident autopsy plane over the scheduler's own stats surface —
+        # detector + recorder polled at the production scrape cadence.
+        plane = IncidentPlane(
+            IncidentConfig(dir=incident_dir),
+            state_probe=sched.debug_state,
+            flight_probe=sched.flight.ring_snapshot,
+            config_probe=sched.config_snapshot,
+        )
+
+        def sched_stats() -> dict:
+            s = dict(sched.flight.to_stats())
+            s.update(sched.slo.to_stats())
+            s["digests"] = sched.telemetry.to_wire()
+            return s
+
+        # Host stack sampler armed for the whole measured section at its
+        # production period.
+        sampler = HostStackSampler(interval_s=0.005)
+        sampler.start()
         measure(sched, False)  # admission-wave + decode executable warmup
         # The warmup measurement compiled every serving shape this section
         # touches: from here, compiles are the 0-post-warmup invariant.
@@ -1027,7 +1059,12 @@ def bench_observability_overhead():
             best_off = max(best_off, measure(sched, False))
             best_on = max(best_on, measure(sched, True))
             watchdog.check()  # the production poll cadence rides along
+            plane.observe(sched_stats())  # detector check per scrape
+        sampler.stop()
+        sampler_report = sampler.report(top=5)
+        plane_stats = plane.to_stats()
         tracer = get_tracer()
+        ring_records = len(tracer.ring_records())
         tracer.flush()
         off = {"traced": False, "tok_s": round(best_off, 1),
                "rounds": rounds, "trace_records": 0}
@@ -1076,8 +1113,21 @@ def bench_observability_overhead():
         "slo_judged_requests": slo_judged,
         "compiles_after_warmup": compiles_after_warmup,
         "stats_path_allowed_syncs": 0,
-        "note": "tiny model on CPU, sample=1.0 with live JSONL export — the "
-                "worst case; production sampling (e.g. 0.1) costs "
+        # Incident autopsy plane armed for the whole section: detector
+        # polled per round, trace ring + tail keep live, host stack
+        # sampler running at its production period. Calm traffic must not
+        # fire (a false positive here is a detector bug worth failing on).
+        "incident_plane": {
+            "detector_checks": plane.detector.checks_total,
+            "incidents": plane_stats["incidents_total"],
+            "trace_ring_records": ring_records,
+            "host_sampler_samples": sampler_report["samples"],
+            "host_sampler_scheduler_share": sampler_report["scheduler_share"],
+            "incident_dir": incident_dir,
+        },
+        "note": "tiny model on CPU, sample=1.0 with live JSONL export, trace "
+                "ring + tail keep + anomaly detector + host stack sampler all "
+                "armed — the worst case; production sampling (e.g. 0.1) costs "
                 "proportionally less. Digests + SLO judge + roofline model "
                 "+ watchdog are live in both phases.",
     }

@@ -78,6 +78,15 @@ class EngineArgs:
     # warmup so the masked-sampling executables precompile; without it,
     # guided requests are rejected engine-side.
     tokenizer: Optional[Any] = None
+    # Incident autopsy plane (runtime/incidents.py): anomaly-triggered
+    # black-box bundles land here (None falls back to DYN_INCIDENT_DIR;
+    # unset = detect + count but never write). The detector itself is
+    # always armed — it is host-side work on the stats-scrape cadence.
+    incident_dir: Optional[str] = None
+    incident_keep: int = 16
+    # Attach a short jax.profiler device capture to each bundle (TPU
+    # diagnosis: was the spike device time or host time?).
+    profile_on_incident: bool = False
 
 
 class TpuEngine:
@@ -103,6 +112,19 @@ class TpuEngine:
         self.watchdog = StallWatchdog(
             probe=lambda: (scheduler.has_work(), scheduler.flight.last_step_ts),
             stall_after_s=scheduler.sc.stall_after_s,
+        )
+        # Incident autopsy plane: the anomaly detector rides every stats
+        # scrape (same lazy cadence as the watchdog) and, when a signal
+        # fires, the recorder snapshots a self-contained black-box bundle.
+        # build() replaces this default (capture-disabled) plane with one
+        # pointed at EngineArgs.incident_dir.
+        from dynamo_tpu.runtime.incidents import IncidentConfig, IncidentPlane
+
+        self.incidents = IncidentPlane(
+            IncidentConfig(),
+            state_probe=self.debug_state,
+            flight_probe=scheduler.flight.ring_snapshot,
+            config_probe=scheduler.config_snapshot,
         )
 
     # --- construction -------------------------------------------------------
@@ -179,6 +201,32 @@ class TpuEngine:
         # From here on, compiles are mid-traffic: the flight recorder counts
         # them (and alerts when a warmup pass was supposed to cover them).
         engine.scheduler.flight.mark_warmup_done(warmed=args.warmup_ctx > 0)
+        # Incident capture: point the plane at the bundle directory (CLI /
+        # env); the detector is armed either way — counters flow to the
+        # scrape even when no bundles are written.
+        import os as _os
+
+        from dynamo_tpu.runtime.incidents import INCIDENT_DIR_ENV, IncidentConfig, IncidentPlane
+
+        incident_dir = args.incident_dir or _os.environ.get(INCIDENT_DIR_ENV) or None
+        profiler = None
+        if args.profile_on_incident:
+            from dynamo_tpu.runtime.profiling import DeviceProfiler
+
+            profiler = DeviceProfiler(
+                out_dir=_os.path.join(incident_dir, "profiles") if incident_dir else None
+            )
+        engine.incidents = IncidentPlane(
+            IncidentConfig(
+                dir=incident_dir,
+                keep=args.incident_keep,
+                profile_on_incident=args.profile_on_incident,
+            ),
+            state_probe=engine.debug_state,
+            flight_probe=engine.scheduler.flight.ring_snapshot,
+            config_probe=engine.scheduler.config_snapshot,
+            profiler=profiler,
+        )
         if args.kvbm_host_blocks > 0:
             from dynamo_tpu.llm.block_manager import KvBlockManager
 
@@ -298,11 +346,14 @@ class TpuEngine:
                 mm if hasattr(mm, "shape") else features_from_wire(mm)
             )
         # Request tracing: hand the scheduler the (trace_id, parent_span)
-        # pair only for sampled traces — the deterministic head-sampling
-        # decision matches the frontend's, so one request is one trace.
+        # pair only for traces that should record — head-sampled (the
+        # deterministic decision matches the frontend's, so one request is
+        # one trace) or, in tail mode, every trace: unsampled records stay
+        # in the in-memory ring for SLO-violation promotion and incident
+        # bundles instead of exporting.
         tracer = get_tracer()
         tp = context.traceparent
-        if tracer.enabled and tp is not None and tracer.sampled(tp.trace_id):
+        if tracer.enabled and tp is not None and tracer.record_allowed(tp.trace_id):
             extras["trace"] = (tp.trace_id, tp.parent_id)
         queue: "asyncio.Queue[StepOutput]" = asyncio.Queue()
         self._staged_adds.append((rid, list(request["token_ids"]), sampling, stop, queue, extras))
@@ -455,6 +506,11 @@ class TpuEngine:
         # visible so dashboards can watch structured-output traffic).
         if self.scheduler.guided is not None:
             stats.update(self.scheduler.guided.stats())
+        # Incident autopsy plane: the detector checks THIS snapshot (the
+        # scrape is the poll cadence, exactly like the watchdog above) and
+        # may write a black-box bundle; its counters ride the same scrape.
+        self.incidents.observe(stats)
+        stats.update(self.incidents.to_stats())
         return stats
 
     def debug_state(self) -> dict:
@@ -462,6 +518,7 @@ class TpuEngine:
         state = self.scheduler.debug_state()
         state["watchdog"] = self.watchdog.to_stats()
         state["watchdog"]["stall_after_s"] = self.watchdog.stall_after_s
+        state["incidents"] = self.incidents.debug_info()
         return state
 
     def attach_guided_tokenizer(self, tokenizer) -> None:

@@ -419,6 +419,32 @@ class FlightRecorder:
         h = self._gap if phase == "host_gap" else self._hists[phase]
         return h.buckets, list(h.counts)
 
+    def ring_snapshot(self) -> dict:
+        """Incident-bundle view of the flight recorder: the recent-step
+        ring verbatim plus the host-gap and compile evidence — enough for
+        ``tools/autopsy.py`` to reconstruct "what the engine was doing in
+        the seconds before the trigger" without the live process."""
+        now = time.monotonic()
+        return {
+            "recent_steps": [
+                {"age_s": round(now - ts, 3), "phase": ph, "dur_s": d, "tokens": t}
+                for ts, ph, d, t in list(self.recent_steps)
+            ],
+            "last_step_phase": self.last_step_phase,
+            "last_step_age_s": (
+                round(now - self.last_step_ts, 3) if self.last_step_ts is not None else None
+            ),
+            "host_gap": {
+                "events": self._gap.total,
+                "sum_s": round(self._gap.sum_s, 6),
+                "p50_s": round(self._gap.percentile(0.5), 6),
+                "p99_s": round(self._gap.percentile(0.99), 6),
+            },
+            "compiles_total": self.compiles_total,
+            "compiles_after_warmup_total": self.compiles_after_warmup_total,
+            "post_warmup_keys": [str(k) for k in self.post_warmup_keys[-16:]],
+        }
+
 
 class StepTimer:
     """Tiny context helper: ``with StepTimer() as t: ...; flight.record_step

@@ -893,6 +893,27 @@ class Scheduler:
             },
         }
 
+    def config_snapshot(self) -> dict:
+        """Deployment configuration for incident bundles: the scheduler
+        knobs and the model/attention identity that reproduce the serving
+        behavior under diagnosis (a bundle without its config is a mystery
+        six months later)."""
+        return {
+            "scheduler": {
+                k: v for k, v in vars(self.sc).items() if not k.startswith("_")
+            },
+            "model": {
+                "name": self.mc.name,
+                "architecture": self.mc.architecture,
+                "max_seq_len": self.mc.max_seq_len,
+                "block_size": self.mc.block_size,
+                "kv_cache_dtype": getattr(self.mc, "kv_cache_dtype", None),
+                "weight_dtype": getattr(self.mc, "weight_dtype", None),
+                "attention_impl": self._attn_impl,
+            },
+            "parallel": str(self.parallel) if self.parallel is not None else None,
+        }
+
     # --- step loop core (runs in worker thread) -----------------------------
     def step(self) -> List[tuple]:
         """One scheduler iteration. Returns [(seq, StepOutput), ...].

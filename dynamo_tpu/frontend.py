@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-file", default=None, help="JSONL span export path (enables tracing)")
     p.add_argument("--trace-sample", type=float, default=None,
                    help="trace sampling ratio in [0,1]; decision is per-trace-id (default 1.0)")
+    p.add_argument("--trace-ring", type=int, default=None,
+                   help="in-memory trace black-box depth in records (default 256; 0 disables)")
+    p.add_argument("--trace-tail", action="store_true",
+                   help="tail-based keep: requests that violate their SLO keep their full "
+                        "span set regardless of --trace-sample (promoted from the ring)")
     # SLA telemetry: judge every request's e2e TTFT/TPOT against these
     # targets — slo_{attained,violated}_total{phase} counters + goodput
     # (SLO-attained req/s, tok/s) on /metrics.
@@ -82,7 +87,8 @@ def main() -> None:
     args = build_parser().parse_args()
     from dynamo_tpu.runtime.tracing import configure_tracing
 
-    configure_tracing(path=args.trace_file, sample=args.trace_sample, service="frontend")
+    configure_tracing(path=args.trace_file, sample=args.trace_sample, service="frontend",
+                      ring_size=args.trace_ring, tail=args.trace_tail or None)
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

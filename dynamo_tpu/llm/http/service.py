@@ -150,6 +150,7 @@ class HttpService:
         first_at: Optional[float],
         last_at: Optional[float],
         n_tokens: int,
+        ctx=None,
     ) -> None:
         """End-of-request e2e telemetry: digests + SLO judgment + goodput.
         Requests that never produced a token (errors, rejections) are not
@@ -167,6 +168,13 @@ class HttpService:
         if not self.slo.enabled:
             return
         good = self._slo_judge.judge(ttft_s, tpot_s, n_tokens)
+        if not good and ctx is not None and get_tracer().tail:
+            # Tail-based sampling: a request that violated its SLO keeps
+            # its full span set regardless of the head-sampling rate. The
+            # promotion itself is deferred to the request handler's finally
+            # — the root http_request span has not ended yet here, and it
+            # must be in the ring before the trace is promoted.
+            ctx.metadata["_slo_promote"] = True
         if self.slo.ttft_ms is not None:
             verdict = "attained" if ttft_s * 1000.0 <= self.slo.ttft_ms else "violated"
             self._m_slo(model, "ttft", verdict).inc()
@@ -591,6 +599,16 @@ class HttpService:
             self._m_inflight(model).dec()
             self._m_duration(model).observe(time.monotonic() - start)
             span.end()
+            if ctx.metadata.pop("_slo_promote", False):
+                tracer = get_tracer()
+                tp = getattr(ctx, "traceparent", None)
+                if tp is not None:
+                    promoted = tracer.promote(tp.trace_id)
+                    if promoted:
+                        logger.info(
+                            "slo violation: promoted %d buffered trace records for %s",
+                            promoted, tp.trace_id,
+                        )
 
     @staticmethod
     def _choice_bodies(body: dict) -> list:
@@ -698,7 +716,7 @@ class HttpService:
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
         self._record_request_telemetry(
-            model, start, first_box[0], last_box[0], results[0]["n_tokens"]
+            model, start, first_box[0], last_box[0], results[0]["n_tokens"], ctx=ctx
         )
         usage = oai.usage_dict(
             prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens,
@@ -816,7 +834,7 @@ class HttpService:
             self._m_requests(model, status).inc()
             self._m_output_tokens(model).inc(n_tokens)
             if status == "200":
-                self._record_request_telemetry(model, start, first_at, prev_tok_at, n_tokens)
+                self._record_request_telemetry(model, start, first_at, prev_tok_at, n_tokens, ctx=ctx)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp

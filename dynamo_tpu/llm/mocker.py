@@ -166,6 +166,16 @@ class MockTpuEngine:
         self.telemetry = Telemetry()
         self.slo = SloJudge(SloConfig(ttft_ms=self.args.slo_ttft_ms,
                                       tpot_ms=self.args.slo_tpot_ms))
+        # Incident autopsy plane (runtime/incidents.py): the mocker runs the
+        # REAL detector over its own simulated stats and emits the same
+        # incidents_*/gauge keys as TpuEngine, so planner/autoscaler stacks
+        # observe identical metric families from an engine-free fleet.
+        from dynamo_tpu.runtime.incidents import IncidentConfig, IncidentPlane
+
+        self.incidents = IncidentPlane(
+            IncidentConfig(),
+            config_probe=lambda: {"engine": "mocker", "args": vars(self.args)},
+        )
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
 
@@ -503,4 +513,8 @@ class MockTpuEngine:
         # can run against pure mocker fleets.
         stats.update(self.slo.to_stats())
         stats["digests"] = self.telemetry.to_wire()
+        # Incident plane: same detector, same incidents_*/profiler keys as
+        # the real engine's scrape (engine-free planner stacks included).
+        self.incidents.observe(stats)
+        stats.update(self.incidents.to_stats())
         return stats

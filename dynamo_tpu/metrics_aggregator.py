@@ -38,6 +38,9 @@ GAUGE_KEYS = (
     "hbm_frac_wave", "hbm_frac_spec",
     # Stall watchdog: 1.0 = step loop wedged with work queued.
     "engine_stalled", "last_step_age_s",
+    # Incident autopsy plane: seconds since the last black-box capture
+    # (-1 = never) — the "is anything firing / did we capture it" gauge.
+    "incident_last_age_s",
     # Pallas launch sites traced into one fused decode-window executable
     # (must be exactly 1; CI asserts — see flight_recorder).
     "fused_window_pallas_launches",
@@ -91,6 +94,15 @@ COUNTER_KEYS = (
     "engine_stalls_total",
     # Fused megakernel decode windows dispatched (one pallas launch each).
     "fused_windows_total",
+    # Incident autopsy plane (runtime/incidents.py): anomaly-triggered
+    # black-box captures, total and per trigger reason, plus on-demand /
+    # per-incident device-profile captures.
+    "incidents_total",
+    "incidents_ttft_p99_total", "incidents_tpot_p99_total",
+    "incidents_queue_wait_p99_total", "incidents_slo_violation_total",
+    "incidents_post_warmup_compile_total", "incidents_engine_stall_total",
+    "incidents_host_gap_total",
+    "profiler_captures_total",
 )
 
 

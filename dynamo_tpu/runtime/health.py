@@ -90,6 +90,7 @@ class SystemStatusServer:
         metrics: Optional[MetricsRegistry] = None,
         config: Optional[SystemConfig] = None,
         state_probe: Optional[Callable[[], dict]] = None,
+        profiler=None,  # runtime.profiling.DeviceProfiler
     ):
         self.health = health
         self.metrics = metrics
@@ -98,6 +99,11 @@ class SystemStatusServer:
         # TpuEngine.debug_state): running/waiting sequences, block pool,
         # digest snapshots, the recent step timeline.
         self.state_probe = state_probe
+        # On-demand profiling: POST /debug/profile?seconds=N captures a
+        # jax.profiler device trace (kind=host runs the stdlib stack
+        # sampler instead) against the LIVE worker — no restart, no
+        # pre-armed tracing.
+        self.profiler = profiler
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
@@ -108,6 +114,7 @@ class SystemStatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/state", self._debug_state)
         app.router.add_get("/debug/stacks", self._debug_stacks)
+        app.router.add_post("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.host, self.config.port)
@@ -143,6 +150,51 @@ class SystemStatusServer:
             state = {"error": f"{type(e).__name__}: {e}"}
         return web.Response(
             status=200, text=json.dumps(state, default=str), content_type="application/json"
+        )
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand profile window against the live process.
+
+        ``POST /debug/profile?seconds=N[&kind=device|host]`` — ``device``
+        (default) runs a programmatic jax.profiler capture and returns the
+        artifact path; ``host`` runs the stdlib stack sampler and returns
+        the aggregated frame report (where is host time going, by scheduler
+        code path). Both run in a thread so the event loop keeps serving
+        health probes during the window."""
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.Response(
+                status=400,
+                text=json.dumps({"error": "seconds must be a number"}),
+                content_type="application/json",
+            )
+        if not 0 < seconds <= 60:
+            return web.Response(
+                status=400,
+                text=json.dumps({"error": "seconds must be in (0, 60]"}),
+                content_type="application/json",
+            )
+        kind = request.query.get("kind", "device")
+        if kind == "host":
+            from dynamo_tpu.runtime.profiling import HostStackSampler
+
+            report = await asyncio.to_thread(HostStackSampler().sample_for, seconds)
+            return web.Response(
+                status=200, text=json.dumps({"kind": "host", **report}),
+                content_type="application/json",
+            )
+        if self.profiler is None:
+            return web.Response(
+                status=404,
+                text=json.dumps({"error": "no device profiler attached"}),
+                content_type="application/json",
+            )
+        result = await asyncio.to_thread(self.profiler.capture, seconds, "http")
+        status = 200 if result.get("status") == "ok" else 409 if result.get("status") == "busy" else 500
+        return web.Response(
+            status=status, text=json.dumps({"kind": "device", **result}),
+            content_type="application/json",
         )
 
     async def _debug_stacks(self, request: web.Request) -> web.Response:

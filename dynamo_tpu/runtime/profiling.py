@@ -1,0 +1,224 @@
+"""On-demand profiling: programmatic device traces + host stack sampling.
+
+Two tools for the "why is it slow *right now*" question, both exposed on
+the worker health server (``POST /debug/profile``) and attachable to
+incident bundles (``--profile-on-incident``):
+
+- ``DeviceProfiler`` — programmatic ``jax.profiler.start_trace`` /
+  ``stop_trace`` capture windows. Until now the only way to get a device
+  profile was re-running the workload with tracing pre-armed; this makes a
+  capture a POST against a live worker. The output directory holds the
+  standard XPlane/Perfetto artifacts (``xplane.pb``, ``trace.json.gz``)
+  that TensorBoard's profile plugin and Perfetto open directly.
+- ``HostStackSampler`` — a pure-stdlib sampling profiler over
+  ``sys._current_frames()``: periodically snapshots every thread's Python
+  stack and aggregates hit counts by frame. The decode host gap (the
+  bubble between a dispatch returning and the next being issued) is host
+  time by definition — this attributes it to actual scheduler code paths
+  (``engine/scheduler.py`` frames get their own rollup) without a native
+  profiler dependency.
+
+Both are strictly off the hot path: the device profiler runs in its own
+thread around a sleep window, the sampler's cost is bounded by its period
+(a stack walk every few ms), and the observability bench runs with the
+sampler armed to prove the combination stays inside the ≤2% budget.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+PROFILE_DIR_ENV = "DYN_PROFILE_DIR"
+
+# /debug/profile refuses windows beyond this: a forgotten profiler is a
+# disk- and overhead-leak on a production worker.
+MAX_CAPTURE_SECONDS = 60.0
+
+
+class DeviceProfiler:
+    """Serialized programmatic jax.profiler captures.
+
+    One capture at a time (jax's profiler is process-global); concurrent
+    requests get a structured "busy" answer instead of a crash. Capture
+    errors (no backend, profiler unavailable) land in the result dict —
+    a debug surface must degrade, not 500.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None):
+        self.out_dir = out_dir or os.environ.get(PROFILE_DIR_ENV) or "/tmp/dynamo_profiles"
+        self._lock = threading.Lock()
+        self._busy = False  # guarded-by: _lock
+        self.captures_total = 0  # guarded-by: _lock
+        self.last: Optional[dict] = None  # guarded-by: _lock
+
+    def capture(self, seconds: float, label: str = "manual") -> dict:
+        """Blocking capture: start the device trace, hold it open for
+        ``seconds`` of live traffic, stop, return the artifact location."""
+        seconds = min(max(float(seconds), 0.05), MAX_CAPTURE_SECONDS)
+        with self._lock:
+            if self._busy:
+                return {"status": "busy", "error": "a capture is already running"}
+            self._busy = True
+            seq = self.captures_total + 1
+        path = os.path.join(self.out_dir, f"profile_{seq:04d}_{label}")
+        result = {"status": "ok", "path": path, "seconds": seconds, "label": label}
+        try:
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — degrade to a structured error
+            result = {"status": f"error: {type(e).__name__}: {e}", "path": path,
+                      "seconds": seconds, "label": label}
+            logger.warning("device profile capture failed: %s", result["status"])
+        with self._lock:
+            self._busy = False
+            if result["status"] == "ok":
+                self.captures_total += 1
+            self.last = result
+        return result
+
+    def capture_background(self, seconds: float, label: str = "incident") -> threading.Thread:
+        """Fire-and-forget capture on a daemon thread (the incident-capture
+        path: the stats scrape must not block on the profile window)."""
+        t = threading.Thread(
+            target=self.capture, args=(seconds, label),
+            name="device-profile-capture", daemon=True,
+        )
+        t.start()
+        return t
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "busy": self._busy,
+                "captures_total": self.captures_total,
+                "out_dir": self.out_dir,
+                "last": dict(self.last) if self.last else None,
+            }
+
+
+def _frame_key(frame) -> Optional[str]:
+    """Innermost frame inside this package, as ``file:line func`` — the
+    attribution unit. Frames entirely outside dynamo_tpu (idle selector
+    loops, queue waits in aiohttp) collapse to their leaf frame."""
+    f = frame
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "dynamo_tpu" in fn:
+            short = fn[fn.rindex("dynamo_tpu"):]
+            return f"{short}:{f.f_lineno} {f.f_code.co_name}"
+        f = f.f_back
+    return None
+
+
+class HostStackSampler:
+    """Stdlib sampling profiler: attributes host time to code paths.
+
+    ``start()``/``stop()`` run it continuously from a daemon thread;
+    ``sample_for(seconds)`` is the blocking one-shot used by
+    ``POST /debug/profile?kind=host``. ``report()`` returns the top frames
+    overall plus the ``engine/scheduler.py`` rollup — the "which scheduler
+    code path owns the host gap" answer.
+    """
+
+    def __init__(self, interval_s: float = 0.005):
+        self.interval_s = max(float(interval_s), 0.001)
+        self._lock = threading.Lock()
+        self._counts: Counter = Counter()  # guarded-by: _lock
+        self._other = 0  # guarded-by: _lock  (samples with no dynamo frame)
+        self.samples = 0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- continuous mode ----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="host-stack-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._sample()
+
+    # --- one-shot mode ------------------------------------------------------
+    def sample_for(self, seconds: float) -> dict:
+        """Blocking burst of samples for ``seconds``; returns the report of
+        ONLY this burst (state is reset first)."""
+        self.reset()
+        deadline = time.monotonic() + min(max(float(seconds), 0.05), MAX_CAPTURE_SECONDS)
+        while time.monotonic() < deadline:
+            self._sample()
+            time.sleep(self.interval_s)
+        return self.report()
+
+    # --- core ---------------------------------------------------------------
+    def _sample(self) -> None:
+        me = threading.get_ident()
+        hits: List[str] = []
+        misses = 0
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            key = _frame_key(frame)
+            if key is None:
+                misses += 1
+            else:
+                hits.append(key)
+        with self._lock:
+            self.samples += 1
+            self._other += misses
+            for key in hits:
+                self._counts[key] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._other = 0
+            self.samples = 0
+
+    def report(self, top: int = 15) -> dict:
+        """Top frames by samples + the scheduler-path rollup share."""
+        with self._lock:
+            counts = Counter(self._counts)
+            samples = self.samples
+            other = self._other
+        total_hits = sum(counts.values())
+        sched = sum(c for k, c in counts.items() if "engine/scheduler.py" in k)
+        return {
+            "samples": samples,
+            "attributed": total_hits,
+            "unattributed_thread_samples": other,
+            "scheduler_share": round(sched / total_hits, 4) if total_hits else 0.0,
+            "top": [
+                {
+                    "frame": key,
+                    "count": c,
+                    "share": round(c / total_hits, 4) if total_hits else 0.0,
+                }
+                for key, c in counts.most_common(top)
+            ],
+        }

@@ -101,6 +101,21 @@ class LatencyDigest:
     def percentiles(self, qs: Sequence[float] = (0.5, 0.9, 0.99)) -> List[float]:
         return [self.quantile(q) for q in qs]
 
+    def rank(self, value: float) -> float:
+        """Fraction of observed samples ≤ ``value`` — a value's percentile
+        position in the distribution (the inverse of ``quantile``). The
+        autopsy uses this for fleet context: "this request's 480 ms queue
+        wait sits at p99.7 of the window"."""
+        if self.count == 0:
+            return 0.0
+        seen = self.zero_count
+        for key, n in sorted(self._buckets_snapshot().items()):
+            if self._bucket_value(key) <= value:
+                seen += n
+            else:
+                break
+        return seen / self.count
+
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0

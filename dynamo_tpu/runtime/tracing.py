@@ -20,6 +20,19 @@ Design constraints (why this is not just the asyncio Recorder from
 - **Zero overhead when off.** ``tracer.enabled`` is a plain attribute;
   every call site guards on it (or on the per-sequence ``trace`` tuple),
   so the disabled path is one branch.
+- **A black box survives export being off.** The tracer keeps the last
+  ``ring_size`` records in an in-memory ring even when no trace file is
+  configured: when an incident fires, the bundle captures the ring — the
+  trace evidence for "what was the engine doing right before this" no
+  longer depends on someone having been tailing a file.
+- **Tail-based keep for SLO violators.** With ``tail=True``, traces that
+  lose the deterministic head-sampling coin flip still record into the
+  ring (flagged unexported); ``promote(trace_id)`` exports a trace's
+  buffered records after the fact — the frontend calls it when a request
+  violates its SLO, so violating requests keep their full span set at any
+  sampling rate. Promotion is per-process (each process promotes its own
+  ring); cross-process spans of an unsampled trace additionally survive
+  through incident bundles, which carry the ring verbatim.
 
 Span ids follow W3C trace-context: 32-hex trace ids, 16-hex span ids
 (``runtime/logging.py`` TraceParent is the wire carrier).
@@ -34,6 +47,7 @@ import secrets
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Any, Dict, Iterable, List, Optional
 
 from dynamo_tpu.runtime.logging import TraceParent, get_logger
@@ -42,16 +56,21 @@ logger = get_logger(__name__)
 
 TRACE_FILE_ENV = "DYN_TRACE_FILE"
 TRACE_SAMPLE_ENV = "DYN_TRACE_SAMPLE"
+TRACE_RING_ENV = "DYN_TRACE_RING"
+TRACE_TAIL_ENV = "DYN_TRACE_TAIL"
+
+# Default in-memory ring depth once tracing is configured (0 disables).
+DEFAULT_RING_SIZE = 256
 
 
 class Span:
     """An in-flight span. ``end()`` (or the ``with`` block) emits it."""
 
     __slots__ = ("tracer", "name", "service", "trace_id", "span_id", "parent_id",
-                 "start_ns", "attrs", "events", "_done")
+                 "start_ns", "attrs", "events", "export", "_done")
 
     def __init__(self, tracer: "Tracer", name: str, service: str, trace_id: str,
-                 parent_id: Optional[str], attrs: Dict[str, Any]):
+                 parent_id: Optional[str], attrs: Dict[str, Any], export: bool = True):
         self.tracer = tracer
         self.name = name
         self.service = service
@@ -61,6 +80,9 @@ class Span:
         self.start_ns = time.time_ns()
         self.attrs = attrs
         self.events: List[dict] = []
+        # False = ring-only (tail mode, trace not head-sampled): the record
+        # stays promotable until it ages out of the ring.
+        self.export = export
         self._done = False
 
     def event(self, name: str, **attrs: Any) -> None:
@@ -88,7 +110,7 @@ class Span:
             rec["attrs"] = self.attrs
         if self.events:
             rec["events"] = self.events
-        self.tracer._put(rec)
+        self.tracer._put(rec, export=self.export)
 
     def child_traceparent(self) -> TraceParent:
         """Wire carrier for downstream hops: same trace, this span as parent."""
@@ -136,12 +158,21 @@ class Tracer:
     pattern, portable across the thread boundary)."""
 
     def __init__(self, path: Optional[str] = None, sample: float = 1.0,
-                 service: str = "dynamo"):
+                 service: str = "dynamo", ring_size: int = 0, tail: bool = False):
         self.path = path
         self.sample = sample
         self.service = service
-        self.enabled = path is not None and sample > 0.0
+        self.ring_size = max(int(ring_size), 0)
+        # Tail-based keep: record unsampled traces into the ring so they can
+        # be promoted to the export after the fact (SLO violations).
+        self.tail = bool(tail) and self.ring_size > 0
+        # Ring-only tracing (path=None, ring_size>0) is a valid enabled
+        # state: the black box records without any file export configured.
+        self.enabled = (path is not None or self.ring_size > 0) and sample > 0.0
         self.events_written = 0
+        # Ring entries are mutable {"rec": ..., "exported": bool} cells so
+        # promote() can mark what it already shipped (no double-export).
+        self._ring: "deque[dict]" = deque(maxlen=self.ring_size or 1)
         self._queue: "queue.SimpleQueue[Optional[dict]]" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -160,12 +191,21 @@ class Tracer:
         frac = (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) / 0xFFFFFFFF
         return frac < self.sample
 
+    def record_allowed(self, trace_id: str) -> bool:
+        """Should this trace produce records at all? Head-sampled traces
+        export; in tail mode unsampled traces still record into the ring
+        (promotable later)."""
+        if not self.enabled:
+            return False
+        return self.tail or self.sampled(trace_id)
+
     # --- span / event API ---------------------------------------------------
     def span(self, name: str, trace_id: str, parent_id: Optional[str] = None,
              service: Optional[str] = None, **attrs: Any):
-        if not self.sampled(trace_id):
+        if not self.record_allowed(trace_id):
             return NULL_SPAN
-        return Span(self, name, service or self.service, trace_id, parent_id, attrs)
+        return Span(self, name, service or self.service, trace_id, parent_id,
+                    attrs, export=self.sampled(trace_id))
 
     def span_from(self, name: str, tp: TraceParent, **attrs: Any):
         """Span continuing a wire TraceParent (its parent_id is the remote
@@ -175,7 +215,7 @@ class Tracer:
     def event(self, name: str, trace_id: str, parent_id: Optional[str] = None,
               service: Optional[str] = None, **attrs: Any) -> None:
         """Instant (zero-duration) event in a trace."""
-        if not self.sampled(trace_id):
+        if not self.record_allowed(trace_id):
             return
         rec = {
             "kind": "event",
@@ -187,10 +227,37 @@ class Tracer:
         }
         if attrs:
             rec["attrs"] = attrs
-        self._put(rec)
+        self._put(rec, export=self.sampled(trace_id))
+
+    # --- ring / tail promotion ----------------------------------------------
+    def ring_records(self) -> List[dict]:
+        """Snapshot of the in-memory ring, oldest first (incident bundles
+        embed this — the per-process trace black box)."""
+        return [cell["rec"] for cell in list(self._ring)]
+
+    def promote(self, trace_id: str) -> int:
+        """Export every still-buffered (unexported) record of ``trace_id``
+        from the ring — the tail-sampling keep decision. Returns how many
+        records were promoted. A no-op without a trace file (the ring alone
+        already retains them for incident bundles)."""
+        n = 0
+        for cell in list(self._ring):
+            if cell["exported"] or cell["rec"].get("trace_id") != trace_id:
+                continue
+            cell["exported"] = True
+            n += 1
+            if self.path is not None:
+                self._queue.put(cell["rec"])
+        if n and self.path is not None:
+            self._ensure_writer()
+        return n
 
     # --- export plumbing ----------------------------------------------------
-    def _put(self, rec: dict) -> None:
+    def _put(self, rec: dict, export: bool = True) -> None:
+        if self.ring_size:
+            self._ring.append({"rec": rec, "exported": export})
+        if not export or self.path is None:
+            return
         self._queue.put(rec)
         self._ensure_writer()
 
@@ -249,9 +316,13 @@ _TRACER = Tracer(path=None, sample=0.0)
 
 
 def configure_tracing(path: Optional[str] = None, sample: Optional[float] = None,
-                      service: Optional[str] = None) -> Tracer:
+                      service: Optional[str] = None, ring_size: Optional[int] = None,
+                      tail: Optional[bool] = None) -> Tracer:
     """(Re)configure the process tracer. Falls back to ``DYN_TRACE_FILE`` /
-    ``DYN_TRACE_SAMPLE`` env (the knobs worker/frontend CLIs expose)."""
+    ``DYN_TRACE_SAMPLE`` / ``DYN_TRACE_RING`` / ``DYN_TRACE_TAIL`` env (the
+    knobs worker/frontend CLIs expose). The ring defaults ON
+    (``DEFAULT_RING_SIZE`` records) so every configured process keeps a
+    trace black box for incident bundles even with no trace file."""
     global _TRACER
     if path is None:
         path = os.environ.get(TRACE_FILE_ENV) or None
@@ -260,8 +331,16 @@ def configure_tracing(path: Optional[str] = None, sample: Optional[float] = None
             sample = float(os.environ.get(TRACE_SAMPLE_ENV, "1.0"))
         except ValueError:
             sample = 1.0
+    if ring_size is None:
+        try:
+            ring_size = int(os.environ.get(TRACE_RING_ENV, str(DEFAULT_RING_SIZE)))
+        except ValueError:
+            ring_size = DEFAULT_RING_SIZE
+    if tail is None:
+        tail = os.environ.get(TRACE_TAIL_ENV, "").strip().lower() in ("1", "true", "yes", "on")
     _TRACER.flush()
-    _TRACER = Tracer(path=path, sample=sample, service=service or _TRACER.service)
+    _TRACER = Tracer(path=path, sample=sample, service=service or _TRACER.service,
+                     ring_size=ring_size, tail=tail)
     return _TRACER
 
 

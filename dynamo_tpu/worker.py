@@ -81,6 +81,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-file", default=None, help="JSONL span export path (enables tracing)")
     p.add_argument("--trace-sample", type=float, default=None,
                    help="trace sampling ratio in [0,1]; decision is per-trace-id (default 1.0)")
+    p.add_argument("--trace-ring", type=int, default=None,
+                   help="in-memory trace black-box depth in records (default 256; 0 disables; "
+                        "incident bundles capture this ring even with no trace file)")
+    p.add_argument("--trace-tail", action="store_true",
+                   help="tail-based keep: unsampled traces still record into the ring so "
+                        "SLO-violating requests can be promoted to the export after the fact")
+    # Incident autopsy plane (runtime/incidents.py): anomaly-triggered
+    # black-box bundles + optional per-incident device profile.
+    p.add_argument("--incident-dir", default=None,
+                   help="write anomaly-triggered incident bundles here "
+                        "(default DYN_INCIDENT_DIR; unset = detect + count only)")
+    p.add_argument("--incident-keep", type=int, default=16,
+                   help="LRU retention cap on incident bundle files")
+    p.add_argument("--profile-on-incident", action="store_true",
+                   help="attach a short jax.profiler device capture to each incident bundle")
     p.add_argument("--warmup-ctx", type=int, default=0,
                    help="precompile serving executables for contexts up to this many tokens "
                         "(0 = lazy; the flight recorder then counts mid-traffic compiles)")
@@ -165,6 +180,9 @@ async def amain(args) -> None:
                 kv_cache_dtype=args.kv_cache_dtype,
                 weight_dtype=args.weight_dtype,
                 warmup_ctx=args.warmup_ctx,
+                incident_dir=args.incident_dir,
+                incident_keep=args.incident_keep,
+                profile_on_incident=args.profile_on_incident,
             )
         )
         if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
@@ -240,10 +258,22 @@ async def amain(args) -> None:
                         engine.scheduler.flight.compiles_after_warmup_total,
                 }
             )
+        # On-demand device profiling (POST /debug/profile?seconds=N): reuse
+        # the incident plane's profiler when --profile-on-incident armed
+        # one, else attach a fresh capture-on-request profiler.
+        incidents = getattr(engine, "incidents", None)
+        profiler = incidents.profiler if incidents is not None else None
+        if profiler is None:
+            from dynamo_tpu.runtime.profiling import DeviceProfiler
+
+            profiler = DeviceProfiler()
+            if incidents is not None:
+                incidents.profiler = profiler
         status_server = SystemStatusServer(
             health,
             config=SystemConfig(enabled=True, port=args.health_port, host="0.0.0.0"),
             state_probe=getattr(engine, "debug_state", None),
+            profiler=profiler,
         )
         await status_server.start()
 
@@ -270,7 +300,8 @@ def main() -> None:
     from dynamo_tpu.runtime.tracing import configure_tracing
 
     configure_tracing(path=args.trace_file, sample=args.trace_sample,
-                      service=f"worker-{args.role}")
+                      service=f"worker-{args.role}",
+                      ring_size=args.trace_ring, tail=args.trace_tail or None)
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

@@ -42,7 +42,9 @@ def test_dashboard_metrics_exist_in_code():
     exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
     families = set()
     for e in exprs:
-        for m in re.findall(r"dynamo_[a-z_]+", e):
+        # Digits are legitimate in family names (incidents_ttft_p99_total);
+        # same character class as dtlint's MET001 grafana scan.
+        for m in re.findall(r"dynamo_[a-z0-9_]+", e):
             families.add(re.sub(r"_(bucket|sum|count)$", "", m))
 
     # Frontend metrics are registered in llm/http/service.py (prefix
@@ -68,7 +70,7 @@ def test_dashboard_counters_use_rate_friendly_names():
     rated = set()
     for p in dash["panels"]:
         for t in p["targets"]:
-            for m in re.findall(r"(?:rate|increase)\((dynamo_component_[a-z_]+_total)\b", t["expr"]):
+            for m in re.findall(r"(?:rate|increase)\((dynamo_component_[a-z0-9_]+_total)\b", t["expr"]):
                 rated.add(m)
     assert rated, "dashboard should rate() at least one worker counter"
     counter_fams = {f"dynamo_component_worker_{k}" for k in COUNTER_KEYS}

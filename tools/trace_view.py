@@ -10,12 +10,17 @@ Usage::
 
     python tools/trace_view.py trace.jsonl                 # list traces
     python tools/trace_view.py trace.jsonl -t <trace_id>   # one timeline
+    python tools/trace_view.py trace.jsonl --request <id>  # one request (alias)
     python tools/trace_view.py trace.jsonl --all           # every timeline
     python tools/trace_view.py trace.jsonl --summary       # digest percentiles
     python tools/trace_view.py trace.jsonl --chrome out.json
+    python tools/trace_view.py incident_0001_queue_wait_p99.json   # bundle ring
 
 Multiple input files merge (frontend + worker processes each write their
-own file; records carry the trace id, so merging is a concat).
+own file; records carry the trace id, so merging is a concat). Incident
+bundles written by ``runtime/incidents.py`` are accepted directly: their
+embedded trace ring joins the record set, so the black box of a crashed
+or anomalous worker renders with the same timelines as a live export.
 
 Crash-time flight recordings are first-class input: a process dying
 mid-write leaves a truncated final line (and possibly records missing
@@ -35,6 +40,21 @@ from dynamo_tpu.runtime.tracing import chrome_trace, read_trace_file
 from dynamo_tpu.runtime.telemetry import LatencyDigest
 
 BAR_WIDTH = 40
+
+
+def read_records(path: str) -> List[dict]:
+    """Records from a JSONL trace file OR an incident bundle (whose
+    ``trace_ring`` is the per-process black box at capture time)."""
+    try:
+        from dynamo_tpu.runtime.incidents import BUNDLE_SCHEMA
+
+        with open(path) as f:
+            obj = json.load(f)
+        if isinstance(obj, dict) and obj.get("schema") == BUNDLE_SCHEMA:
+            return [r for r in obj.get("trace_ring") or [] if isinstance(r, dict)]
+    except (OSError, ValueError):
+        pass
+    return read_trace_file(path)
 
 
 def group_by_trace(records: List[dict]) -> Dict[str, List[dict]]:
@@ -134,18 +154,26 @@ def render_timeline(trace_id: str, recs: List[dict], out=sys.stdout) -> None:
 
 def main() -> int:
     p = argparse.ArgumentParser(description="dynamo-tpu trace viewer")
-    p.add_argument("files", nargs="+", help="JSONL trace files (merged)")
+    p.add_argument("files", nargs="+",
+                   help="JSONL trace files and/or incident bundles (merged)")
     p.add_argument("-t", "--trace-id", default=None, help="render one trace's timeline")
+    p.add_argument("--request", default=None, metavar="TRACE_ID",
+                   help="filter the timeline/summary to one request's trace id")
     p.add_argument("--all", action="store_true", help="render every trace's timeline")
     p.add_argument("--summary", action="store_true",
                    help="per-phase digest percentiles across all traces")
     p.add_argument("--chrome", default=None, metavar="OUT",
                    help="write a Chrome-trace/Perfetto JSON file")
     args = p.parse_args()
+    if args.request:
+        args.trace_id = args.request
 
     records: List[dict] = []
     for path in args.files:
-        records.extend(read_trace_file(path))
+        records.extend(read_records(path))
+    if args.request:
+        # --request also scopes --summary/--chrome to the one request.
+        records = [r for r in records if r.get("trace_id") == args.request]
 
     if args.summary:
         summarize(records)
