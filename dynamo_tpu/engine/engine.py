@@ -463,6 +463,9 @@ class TpuEngine:
             "num_running": m.num_running,
             "num_waiting": m.num_waiting,
             "preemptions_total": self.scheduler.preempt_total,
+            # Failure-lifecycle counters: deadline evictions (finish_reason
+            # "timeout" + KV freed) — the chaos suite's recovery signal.
+            "request_timeouts_total": self.scheduler.timeouts_total,
             # Mixed-step composition (scrape-visible so the planner and
             # dashboards can see how much prefill rides the decode wave —
             # runtime/metrics.py documents the derived counters).
@@ -506,6 +509,12 @@ class TpuEngine:
         # visible so dashboards can watch structured-output traffic).
         if self.scheduler.guided is not None:
             stats.update(self.scheduler.guided.stats())
+        # Chaos plane: injected-fault counters when an injector is armed
+        # (runtime/faults.py; {} otherwise — the keys only exist on
+        # chaos-armed workers).
+        from dynamo_tpu.runtime import faults as _faults
+
+        stats.update(_faults.stats())
         # Incident autopsy plane: the detector checks THIS snapshot (the
         # scrape is the poll cadence, exactly like the watchdog above) and
         # may write a black-box bundle; its counters ride the same scrape.

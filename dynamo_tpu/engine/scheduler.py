@@ -84,15 +84,22 @@ class StopConditions:
     min_tokens: int = 0
     stop_token_ids: List[int] = field(default_factory=list)
     ignore_eos: bool = False
+    # Remaining deadline budget in ms at arrival (wire: the frontend's
+    # --request-timeout-ms / client ``timeout``, minus time already spent).
+    # Past-deadline rows are evicted with finish_reason "timeout" and their
+    # KV freed — a hung or saturated engine cannot hold a request forever.
+    deadline_ms: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "StopConditions":
         d = d or {}
+        dl = d.get("deadline_ms")
         return cls(
             max_tokens=d.get("max_tokens") or 256,
             min_tokens=d.get("min_tokens") or 0,
             stop_token_ids=list(d.get("stop_token_ids") or []),
             ignore_eos=bool(d.get("ignore_eos", False)),
+            deadline_ms=float(dl) if dl else None,
         )
 
 
@@ -141,6 +148,9 @@ class Sequence:
     first_token_ts: Optional[float] = None
     aborted: bool = False
     abort_reason: str = "cancelled"
+    # Absolute eviction deadline (arrival + stop.deadline_ms); None = no
+    # deadline. Swept every step in _reap_aborted.
+    deadline_ts: Optional[float] = None
     # Disaggregation: prefill-role sequences keep their blocks at finish for
     # export to the decode worker (ref: vllm do_remote_decode flow, §3C).
     keep_blocks_on_finish: bool = False
@@ -376,6 +386,10 @@ class Scheduler:
         self.by_id: Dict[str, Sequence] = {}
         self.request_total = 0
         self.preempt_total = 0
+        # Deadline eviction: requests whose deadline_ms budget lapsed before
+        # they finished (finish_reason "timeout", KV freed at eviction).
+        self.timeouts_total = 0
+        self._has_deadlines = False  # skip the per-step sweep until one arrives
         # Online prefill-rate estimate (tokens/s) for ITL-budgeted chunking.
         self._prefill_tok_s: Optional[float] = None
         self._eos = eos_token_ids or []
@@ -754,6 +768,9 @@ class Scheduler:
         )
         if guided is not None:
             seq.guided = self.guided.open(guided)  # ValueError on a bad spec
+        if stop.deadline_ms is not None:
+            seq.deadline_ts = seq.arrival_ts + stop.deadline_ms / 1000.0
+            self._has_deadlines = True
         self.waiting.append(seq)
         self.by_id[request_id] = seq
         self.request_total += 1
@@ -930,6 +947,10 @@ class Scheduler:
         unless a composition change (waiting work, aborts, block growth,
         finish) forces a flush back to this sync path."""
         outputs: List[tuple] = []
+        # Deadline sweep runs before the overlap fast path too: an expired
+        # row marks itself aborted, which forces the pipeline flush below
+        # (otherwise a pure-decode window could outlive the deadline).
+        self._sweep_deadlines()
         if self._pipe is not None:
             if self._overlap_should_continue():
                 self._overlap_step(outputs)
@@ -1132,6 +1153,29 @@ class Scheduler:
                 seq.block_ids = []
                 self.by_id.pop(seq.request_id, None)
                 outputs.append((seq, StepOutput(token_id=-1, finished=True, finish_reason=seq.abort_reason)))
+
+    def _sweep_deadlines(self) -> None:
+        """Mark past-deadline rows aborted with reason "timeout"; the
+        regular reap then frees their KV and emits the final frame. Runs at
+        the head of every step (host-side, O(live rows)) but only once any
+        deadline-carrying request has been admitted."""
+        if not self._has_deadlines:
+            return
+        now = time.monotonic()
+        for seq in self.running + self.waiting:
+            if (
+                seq.deadline_ts is not None
+                and not seq.aborted
+                and now >= seq.deadline_ts
+            ):
+                seq.aborted = True
+                seq.abort_reason = "timeout"
+                self.timeouts_total += 1
+                self._trace_event(
+                    seq, "deadline_evict",
+                    overrun_ms=round((now - seq.deadline_ts) * 1000.0, 3),
+                    output_tokens=len(seq.output_ids),
+                )
 
     def _admit(self, outputs: List[tuple]) -> None:
         """Admit waiting sequences: a batched WAVE when several short
@@ -2816,7 +2860,7 @@ class Scheduler:
             seq.block_hashes = extend_block_hashes(seq.block_hashes, seq.all_ids, bs)
             n_full = len(seq.all_ids) // bs
             self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
-        if seq.keep_blocks_on_finish and reason != "cancelled":
+        if seq.keep_blocks_on_finish and reason not in ("cancelled", "timeout"):
             # Disagg prefill role: hold blocks until the decode worker pulls
             # them (take_export); refs stay live so eviction can't touch them.
             self._pending_exports[seq.request_id] = seq

@@ -50,6 +50,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TTFT SLO target in ms (enables SLO/goodput accounting)")
     p.add_argument("--slo-tpot-ms", type=float, default=None,
                    help="per-output-token latency SLO target in ms")
+    # Failure lifecycle: request deadlines, router retry budget, breaker.
+    p.add_argument("--request-timeout-ms", type=float, default=None,
+                   help="default end-to-end request deadline; past-deadline "
+                        "requests are evicted engine-side and answered 504 "
+                        "with partial usage (client 'timeout' overrides)")
+    p.add_argument("--retry-max", type=int, default=3,
+                   help="router NoInstances retries (jittered exponential backoff)")
+    p.add_argument("--retry-backoff-ms", type=float, default=50.0,
+                   help="base backoff between NoInstances retries")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive stream failures that trip a worker's circuit OPEN")
+    p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                   help="seconds a tripped circuit stays OPEN before one half-open probe")
+    # Chaos plane (runtime/faults.py): deterministic fault injection.
+    p.add_argument("--fault-scenario", default=None,
+                   help="arm the fault injector: inline JSON or @/path/to/scenario.json "
+                        "(DYN_FAULTS env is the default)")
     return p
 
 
@@ -71,6 +88,11 @@ async def amain(args) -> None:
         encode_component=args.encode_component,
         slo_ttft_ms=args.slo_ttft_ms,
         slo_tpot_ms=args.slo_tpot_ms,
+        request_timeout_ms=args.request_timeout_ms,
+        retry_max=args.retry_max,
+        retry_backoff_base_s=args.retry_backoff_ms / 1000.0,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
     )
     service = await start_frontend(drt, config)
     logger.info("frontend ready on %s:%d (router=%s)", args.http_host, service.port, args.router_mode)
@@ -89,6 +111,12 @@ def main() -> None:
 
     configure_tracing(path=args.trace_file, sample=args.trace_sample, service="frontend",
                       ring_size=args.trace_ring, tail=args.trace_tail or None)
+    from dynamo_tpu.runtime import faults
+
+    if args.fault_scenario:
+        faults.arm_from_spec(args.fault_scenario)
+    else:
+        faults.maybe_arm_from_env()
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

@@ -88,6 +88,7 @@ def build_routed_pipeline(
     migration_limit: int = 0,
     encoder=None,
     encode_client=None,
+    on_migrate=None,
 ) -> AsyncEngine:
     """Frontend-side routed pipeline: preprocessor → [encode] → backend →
     migration → router (ref: input/common.rs:226)."""
@@ -105,8 +106,14 @@ def build_routed_pipeline(
     ops.append(Backend(tokenizer))
     limit = migration_limit if migration_limit else (card.migration_limit if card else 0)
     if limit > 0:
-        ops.append(Migration(limit))
-    return link(ops, RouterEngine(router))
+        ops.append(Migration(limit, on_migrate=on_migrate))
+    composed = link(ops, RouterEngine(router))
+    # Pre-flight availability for the HTTP layer: zero live instances ⇒ an
+    # immediate retryable 503 instead of a 500 after the retry budget burns.
+    client = getattr(router, "client", None)
+    if client is not None:
+        composed.availability_probe = lambda: len(client.instances)
+    return composed
 
 
 async def register_llm(
@@ -130,8 +137,13 @@ async def register_llm(
         endpoint=endpoint.name,
         card=card,
     )
-    await drt.store.put(entry.store_key, entry.to_json(), lease_id=handle.lease.id)
-    logger.info("registered model %s at %s", card.name, entry.store_key)
+    # Per-instance model key (ref: model_entry.rs keys carry the lease):
+    # N workers serving the same model register N keys, so the frontend
+    # watcher's refcount drops the model only when the LAST one goes — a
+    # drained/crashed worker cannot take the model down for its survivors.
+    key = f"{entry.store_key}:{handle.lease.id:x}"
+    await drt.store.put(key, entry.to_json(), lease_id=handle.lease.id)
+    logger.info("registered model %s at %s", card.name, key)
     return handle, entry
 
 
@@ -159,16 +171,33 @@ class FrontendConfig:
     # (--slo-ttft-ms/--slo-tpot-ms; None = phase unjudged).
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # Default end-to-end request deadline (--request-timeout-ms); a client
+    # ``timeout`` (seconds) overrides per request. None = no deadline.
+    request_timeout_ms: Optional[float] = None
+    # Router failure lifecycle: NoInstances retry budget (jittered
+    # exponential backoff) and the per-worker circuit breaker.
+    retry_max: int = 3
+    retry_backoff_base_s: float = 0.05
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
 
 
 async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> HttpService:
     """Start the OpenAI frontend with dynamic model discovery: every model
     registered in the KV store gets a routed pipeline."""
+    from dynamo_tpu.runtime.metrics import FRONTEND_PREFIX, MetricsRegistry
+    from dynamo_tpu.runtime.push_router import CircuitBreaker, RetryPolicy
+
     manager = ModelManager()
+    # One registry shared by the HTTP service and the per-model routers so
+    # circuit_open{worker} / migrations_total land on the same /metrics.
+    metrics = MetricsRegistry(prefix=FRONTEND_PREFIX)
 
     async def engine_factory(entry: ModelEntry) -> AsyncEngine:
         ep = drt.namespace(entry.namespace).component(entry.component).endpoint(entry.endpoint)
         client = await ep.client()
+        retry = RetryPolicy(max_retries=config.retry_max,
+                            backoff_base_s=config.retry_backoff_base_s)
         if config.router_mode == "kv":
             from dynamo_tpu.llm.kv_router import KvPushRouter, KvRouterConfig
 
@@ -180,9 +209,21 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
                     block_size=entry.card.kv_cache_block_size,
                 ),
             )
+            router.push._metrics = metrics
+            router.push.retry = retry
+            router.push.breaker = CircuitBreaker(
+                threshold=config.breaker_threshold,
+                cooldown_s=config.breaker_cooldown_s,
+                on_transition=router.push._on_circuit_transition,
+            )
         else:
             mode = RouterMode.RANDOM if config.router_mode == "random" else RouterMode.ROUND_ROBIN
-            router = PushRouter(client, mode)
+            router = PushRouter(client, mode, metrics=metrics, retry=retry)
+            router.breaker = CircuitBreaker(
+                threshold=config.breaker_threshold,
+                cooldown_s=config.breaker_cooldown_s,
+                on_transition=router._on_circuit_transition,
+            )
             if config.busy_threshold is not None:
                 router.monitor.busy_threshold = config.busy_threshold
         tokenizer = load_tokenizer(entry.card.tokenizer_path)
@@ -192,9 +233,14 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
                 entry.endpoint
             )
             encode_client = PushRouter(await enc_ep.client(), RouterMode.ROUND_ROBIN)
+        model = entry.card.name
         return build_routed_pipeline(
             tokenizer, router, entry.card, migration_limit=config.migration_limit,
             encode_client=encode_client,
+            on_migrate=lambda: metrics.counter(
+                "migrations_total", "stream drops replayed on another worker",
+                model=model,
+            ).inc(),
         )
 
     watcher = ModelWatcher(drt, manager, engine_factory)
@@ -203,8 +249,10 @@ async def start_frontend(drt: DistributedRuntime, config: FrontendConfig) -> Htt
 
     service = HttpService(
         manager, host=config.host, port=config.port,
+        metrics=metrics,
         tls_cert=config.tls_cert, tls_key=config.tls_key,
         slo=SloConfig(ttft_ms=config.slo_ttft_ms, tpot_ms=config.slo_tpot_ms),
+        request_timeout_ms=config.request_timeout_ms,
     )
     service.watcher = watcher  # keep alive / stoppable
     await service.start()

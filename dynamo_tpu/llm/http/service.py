@@ -24,8 +24,9 @@ from aiohttp import web
 from dynamo_tpu.llm.discovery import ModelManager
 from dynamo_tpu.llm.protocols import openai as oai
 from dynamo_tpu.llm.protocols.common import LLMEngineOutput, as_engine_output
-from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.engine import Annotated, Context, StreamDisconnect
 from dynamo_tpu.runtime.logging import TraceParent, get_logger
+from dynamo_tpu.runtime.push_router import NoInstancesError
 from dynamo_tpu.runtime.tracing import NULL_SPAN, get_tracer
 from dynamo_tpu.runtime.metrics import (
     DURATION_BUCKETS,
@@ -67,10 +68,18 @@ class HttpService:
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
         slo: Optional[SloConfig] = None,
+        request_timeout_ms: Optional[float] = None,
     ):
         self.manager = manager
         self.host = host
         self.port = port
+        # Default end-to-end request deadline (--request-timeout-ms). A
+        # client ``timeout`` (seconds) overrides per request. The budget
+        # rides the wire (stop_conditions.deadline_ms) so the scheduler
+        # evicts past-deadline rows; the frontend's own watchdog is the
+        # backstop for hung workers — either way the client gets a 504
+        # with partial-usage accounting, never a silent hang.
+        self.request_timeout_ms = request_timeout_ms
         # TLS termination (ref: frontend --tls-cert-path/--tls-key-path,
         # components/frontend/src/dynamo/frontend/main.py:81-286): both paths
         # or neither.
@@ -110,6 +119,21 @@ class HttpService:
             buckets=TTFT_BUCKETS, model=model,
         )
         self._m_output_tokens = lambda model: m.counter("output_tokens_total", "output tokens", model=model)
+        # Failure lifecycle: deadline expiries (504s / timeout finishes),
+        # migration replays (stream drops recovered on another worker),
+        # exhausted migrations (502s), and no-instance rejections (503s).
+        self._m_timeouts = lambda model: m.counter(
+            "request_timeouts_total", "requests that exceeded their deadline", model=model
+        )
+        self._m_migrations = lambda model: m.counter(
+            "migrations_total", "stream drops replayed on another worker", model=model
+        )
+        self._m_migration_exhausted = lambda model: m.counter(
+            "migration_exhausted_total", "requests whose migration budget ran out (502)", model=model
+        )
+        self._m_no_instances = lambda model: m.counter(
+            "no_instances_total", "requests rejected because no workers were live (503)", model=model
+        )
         self._m_input_tokens = lambda model: m.counter("input_tokens_total", "input (prompt) tokens", model=model)
         # Engine-reported prefix-cache reuse: prompt tokens served from
         # resident KV (usage.prompt_tokens_details.cached_tokens).
@@ -569,6 +593,31 @@ class HttpService:
             self._m_requests(model, "404").inc()
             return web.json_response(oai.error_body(f"model {model!r} not found", "model_not_found", 404), status=404)
 
+        # Pre-flight availability (routed pipelines expose the router's live
+        # instance count): with zero workers the answer is an immediate,
+        # retryable 503 — not a 500 after the router exhausts its budget,
+        # and for SSE not an error event on an already-200 stream.
+        probe = getattr(engine, "availability_probe", None)
+        if probe is not None and probe() == 0:
+            await asyncio.sleep(0.05)  # one watch delivery: absorb races
+            if probe() == 0:
+                self._m_no_instances(model).inc()
+                self._m_requests(model, "503").inc()
+                return web.json_response(
+                    oai.error_body("no workers are live for this model; retry shortly",
+                                   "service_unavailable", 503),
+                    status=503, headers={"Retry-After": "1"},
+                )
+
+        # Request deadline: client ``timeout`` (seconds) or the frontend
+        # default. Normalized into the body so the preprocessor puts the
+        # budget on the wire (stop_conditions.deadline_ms).
+        timeout_s = body.get("timeout")
+        if timeout_s is None and self.request_timeout_ms:
+            timeout_s = self.request_timeout_ms / 1000.0
+            body["timeout"] = timeout_s
+        deadline = (time.monotonic() + float(timeout_s)) if timeout_s else None
+
         stream = bool(body.get("stream", False))
         ctx = Context(traceparent=TraceParent.from_headers(request.headers) or None)
         # Root (or continuation) span for the request. When sampled, the
@@ -586,8 +635,8 @@ class HttpService:
         self._m_inflight(model).inc()
         try:
             if stream:
-                return await self._serve_stream(request, engine, body, ctx, rid, kind, model, start)
-            return await self._serve_unary(engine, body, ctx, rid, kind, model, start)
+                return await self._serve_stream(request, engine, body, ctx, rid, kind, model, start, deadline)
+            return await self._serve_unary(engine, body, ctx, rid, kind, model, start, deadline)
         except oai.RequestError as e:
             # Pipeline-stage rejection (e.g. image parts with no encode
             # path): a client/deployment-configuration 400, not a 500.
@@ -610,6 +659,45 @@ class HttpService:
                             promoted, tp.trace_id,
                         )
 
+    def _timeout_response(self, ctx, model, prompt_tokens, completion_tokens,
+                          cached_tokens=None) -> web.Response:
+        """504 with partial-usage accounting: the tokens that did stream are
+        real work the client may be billed for, and the counts tell the
+        operator how close the request got before the deadline."""
+        self._m_timeouts(model).inc()
+        self._m_requests(model, "504").inc()
+        body = oai.error_body("request deadline exceeded", "timeout_error", 504)
+        body["usage"] = oai.usage_dict(prompt_tokens, completion_tokens, cached_tokens)
+        return web.json_response(body, status=504, headers=_trace_headers(ctx))
+
+    def _failure_response(self, e, ctx, model, prompt_tokens, completion_tokens):
+        """Map infrastructure failures to structured statuses: no live
+        workers → retryable 503; migration budget exhausted mid-stream →
+        502 carrying the partial token count. None = not ours (500 path)."""
+        if isinstance(e, NoInstancesError):
+            self._m_no_instances(model).inc()
+            self._m_requests(model, "503").inc()
+            return web.json_response(
+                oai.error_body("no workers are live for this model; retry shortly",
+                               "service_unavailable", 503),
+                status=503, headers={"Retry-After": "1", **_trace_headers(ctx)},
+            )
+        if isinstance(e, StreamDisconnect):
+            mig = ctx.metadata.get("migration") or {}
+            self._m_migration_exhausted(model).inc()
+            self._m_requests(model, "502").inc()
+            body = oai.error_body(
+                "upstream worker stream disconnected and the migration budget "
+                "is exhausted", "bad_gateway", 502,
+            )
+            body["error"]["partial_tokens"] = int(
+                mig.get("tokens_emitted", completion_tokens)
+            )
+            body["error"]["migrations"] = int(mig.get("attempts", 0))
+            body["usage"] = oai.usage_dict(prompt_tokens, completion_tokens)
+            return web.json_response(body, status=502, headers=_trace_headers(ctx))
+        return None
+
     @staticmethod
     def _choice_bodies(body: dict) -> list:
         """Per-choice request bodies for n>1: each choice is an independent
@@ -627,12 +715,16 @@ class HttpService:
             out.append(b)
         return out
 
-    async def _serve_unary(self, engine, body, ctx, rid, kind, model, start) -> web.Response:
+    async def _serve_unary(self, engine, body, ctx, rid, kind, model, start, deadline=None) -> web.Response:
         bodies = self._choice_bodies(body)
         prompt_tokens_box = [0]
         cached_tokens_box = [None]
         first_box = [None]
         last_box = [None]
+        # Per-choice live token counts: the 504/502 paths report honest
+        # partial usage even for choices that never reached their final
+        # frame.
+        tokens_box = [0] * len(bodies)
 
         async def run_choice(i: int, b: dict, c: Context) -> dict:
             text_parts = []
@@ -669,6 +761,7 @@ class HttpService:
                 if out.logprobs:
                     logprobs.extend(out.logprobs)
                 n_tokens += len(out.token_ids)
+                tokens_box[i] = n_tokens
                 if out.finish_reason:
                     finish_reason = out.finish_reason
             return {
@@ -689,8 +782,33 @@ class HttpService:
             asyncio.create_task(run_choice(i, b, c))
             for i, (b, c) in enumerate(zip(bodies, ctxs))
         ]
+        frontend_timed_out = False
         try:
-            results = await asyncio.gather(*tasks)
+            if deadline is None:
+                results = await asyncio.gather(*tasks)
+            else:
+                # Frontend deadline backstop: the scheduler evicts
+                # past-deadline rows itself, so the grace window only trips
+                # when a worker is hung or unreachable — then we cancel into
+                # the pipeline and answer 504 with whatever tokens landed.
+                grace = max(0.5, 0.25 * max(deadline - start, 0.0))
+                done, pending = await asyncio.wait(
+                    set(tasks), timeout=max(0.0, deadline + grace - time.monotonic())
+                )
+                if pending:
+                    frontend_timed_out = True
+                    for c in ctxs:
+                        c.stop_generating()
+                    _, still = await asyncio.wait(pending, timeout=2.0)
+                    for t in still:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                for t in tasks:
+                    if t.done() and not t.cancelled() and t.exception() is not None:
+                        raise t.exception()
+                results = [
+                    t.result() for t in tasks if t.done() and not t.cancelled()
+                ]
         except Exception as e:
             # Stop and reap the sibling choices — leaving them running wastes
             # engine work and leaks never-retrieved task exceptions.
@@ -706,12 +824,20 @@ class HttpService:
                 return web.json_response(
                     oai.error_body(str(e)), status=400, headers=_trace_headers(ctx)
                 )
+            mapped = self._failure_response(e, ctx, model, prompt_tokens_box[0], sum(tokens_box))
+            if mapped is not None:
+                return mapped
             logger.exception("request %s failed", ctx.id)
             self._m_requests(model, "500").inc()
             return web.json_response(
                 oai.error_body(str(e), "internal_error", 500), status=500,
                 headers=_trace_headers(ctx),
             )
+        if frontend_timed_out or any(r["finish_reason"] == "timeout" for r in results):
+            # Deadline expiry — engine-evicted (finish_reason "timeout") or
+            # the frontend watchdog above. 504 with partial-usage accounting.
+            return self._timeout_response(ctx, model, prompt_tokens_box[0],
+                                          sum(tokens_box), cached_tokens_box[0])
         self._m_requests(model, "200").inc()
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
@@ -745,9 +871,31 @@ class HttpService:
             oai.completion_response_multi(rid, model, choices, usage), headers=_trace_headers(ctx)
         )
 
-    async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
+    @staticmethod
+    async def _iter_with_deadline(stream, deadline: Optional[float], start: float):
+        """Yield stream items, raising TimeoutError when the deadline (plus
+        a hung-worker grace window — the engine's own eviction should fire
+        first and arrives as a normal finish_reason='timeout' frame) lapses
+        between items."""
+        if deadline is None:
+            async for item in stream:
+                yield item
+            return
+        grace = max(0.5, 0.25 * max(deadline - start, 0.0))
+        it = stream.__aiter__()
+        while True:
+            remaining = deadline + grace - time.monotonic()
+            if remaining <= 0:
+                raise asyncio.TimeoutError
+            try:
+                item = await asyncio.wait_for(it.__anext__(), remaining)
+            except StopAsyncIteration:
+                return
+            yield item
+
+    async def _serve_stream(self, request, engine, body, ctx, rid, kind, model, start, deadline=None) -> web.StreamResponse:
         if int(body.get("n") or 1) > 1:
-            return await self._serve_stream_multi(request, engine, body, ctx, rid, kind, model, start)
+            return await self._serve_stream_multi(request, engine, body, ctx, rid, kind, model, start, deadline)
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -766,7 +914,7 @@ class HttpService:
         try:
             if kind == "chat":
                 await _sse(resp, oai.chat_chunk(rid, model, {"role": "assistant", "content": ""}))
-            async for item in engine.generate(body, ctx):
+            async for item in self._iter_with_deadline(engine.generate(body, ctx), deadline, start):
                 if isinstance(item, Annotated) and item.is_annotation():
                     if item.event.startswith("_"):
                         if item.event == "_metrics":
@@ -815,6 +963,12 @@ class HttpService:
                     ]
                     await _sse(resp, oai.chat_chunk(rid, model, {"tool_calls": delta_calls}))
                 if out.finish_reason:
+                    if out.finish_reason == "timeout":
+                        # Engine-side deadline eviction: headers are long
+                        # gone, so the 504 lives in the finish_reason and
+                        # the status counter.
+                        status = "504"
+                        self._m_timeouts(model).inc()
                     chunk = (
                         oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason)
                         if kind == "chat"
@@ -826,6 +980,36 @@ class HttpService:
             ctx.stop_generating()
             status = "499"
             raise
+        except asyncio.TimeoutError:
+            # Frontend deadline backstop (hung/unreachable worker): cancel
+            # into the pipeline and close the stream with a timeout finish.
+            ctx.stop_generating()
+            status = "504"
+            self._m_timeouts(model).inc()
+            chunk = (
+                oai.chat_chunk(rid, model, {}, finish_reason="timeout")
+                if kind == "chat"
+                else oai.completion_chunk(rid, model, "", finish_reason="timeout")
+            )
+            await _sse(resp, chunk)
+        except NoInstancesError:
+            status = "503"
+            self._m_no_instances(model).inc()
+            await _sse(resp, oai.error_body(
+                "no workers are live for this model; retry shortly",
+                "service_unavailable", 503,
+            ))
+        except StreamDisconnect:
+            mig = ctx.metadata.get("migration") or {}
+            status = "502"
+            self._m_migration_exhausted(model).inc()
+            err = oai.error_body(
+                "upstream worker stream disconnected and the migration budget "
+                "is exhausted", "bad_gateway", 502,
+            )
+            err["error"]["partial_tokens"] = int(mig.get("tokens_emitted", n_tokens))
+            err["error"]["migrations"] = int(mig.get("attempts", 0))
+            await _sse(resp, err)
         except Exception as e:
             logger.exception("stream %s failed", ctx.id)
             status = "500"
@@ -839,7 +1023,7 @@ class HttpService:
         await resp.write_eof()
         return resp
 
-    async def _serve_stream_multi(self, request, engine, body, ctx, rid, kind, model, start) -> web.StreamResponse:
+    async def _serve_stream_multi(self, request, engine, body, ctx, rid, kind, model, start, deadline=None) -> web.StreamResponse:
         """n>1 streaming: one generation per choice, chunks multiplexed onto
         one SSE stream with their choice index (ref: OpenAI n semantics)."""
         resp = web.StreamResponse(
@@ -885,8 +1069,15 @@ class HttpService:
             if kind == "chat":
                 for i in range(len(bodies)):
                     await _sse(resp, oai.chat_chunk(rid, model, {"role": "assistant", "content": ""}, index=i))
+            grace = max(0.5, 0.25 * max(deadline - start, 0.0)) if deadline else 0.0
             while live:
-                i, out, err = await queue.get()
+                if deadline is None:
+                    i, out, err = await queue.get()
+                else:
+                    remaining = deadline + grace - time.monotonic()
+                    if remaining <= 0:
+                        raise asyncio.TimeoutError
+                    i, out, err = await asyncio.wait_for(queue.get(), remaining)
                 if err is not None:
                     raise err
                 if out is None:
@@ -924,6 +1115,19 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             status = "499"
             raise
+        except asyncio.TimeoutError:
+            # Frontend deadline backstop: finish every live choice with a
+            # timeout chunk (headers are long gone; the finally below
+            # cancels into the pipeline).
+            status = "504"
+            self._m_timeouts(model).inc()
+            for i in range(len(bodies)):
+                chunk = (
+                    oai.chat_chunk(rid, model, {}, finish_reason="timeout", index=i)
+                    if kind == "chat"
+                    else oai.completion_chunk(rid, model, "", finish_reason="timeout", index=i)
+                )
+                await _sse(resp, chunk)
         except Exception as e:
             logger.exception("stream %s failed", ctx.id)
             status = "500"

@@ -178,6 +178,12 @@ class KvPushRouter:
 
     async def schedule(self, token_ids, router_overrides: Optional[dict] = None) -> SchedulingDecision:
         workers = self._sync_workers()
+        # Circuit breaker (push router): skip workers with an OPEN circuit
+        # unless that would leave nobody — availability beats purity.
+        blocked = self.push.breaker.blocked_instances()
+        if blocked:
+            unblocked = [w for w in workers if w not in blocked]
+            workers = unblocked or workers
         hashes = compute_block_hashes(token_ids, self.config.block_size)
         prompt_blocks = max(1, (len(token_ids) + self.config.block_size - 1) // self.config.block_size)
         overlaps = self.indexer.find_matches(hashes)

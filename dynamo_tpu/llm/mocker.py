@@ -36,11 +36,16 @@ from typing import Any, AsyncIterator, Callable, List, Optional
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvEvent, OutOfBlocksError
 from dynamo_tpu.engine.scheduler import ForwardPassMetrics
 from dynamo_tpu.llm.tokens import compute_block_hashes
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.telemetry import SloConfig, SloJudge, Telemetry
 
 logger = get_logger(__name__)
+
+# Queue sentinel for an injected engine crash: ``generate`` turns it into an
+# abrupt ConnectionResetError (the stream dies without a final frame).
+_CRASH = object()
 
 
 @dataclass
@@ -67,6 +72,12 @@ class MockEngineArgs:
     # stats keys, so planner tests and traffic harnesses run engine-free.
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # Output-token rule: "cycle" repeats the prompt (default), "position"
+    # emits token = sequence position — position streams continue bit-
+    # identically across a migration replay (prompt + emitted tokens fold
+    # into the replay prompt), which is what the chaos suite's zero-loss /
+    # zero-duplication assertions pin.
+    token_rule: str = "cycle"
     # Back-compat aliases used by older callers/flags.
     prefill_time_per_token_ms: Optional[float] = None
     decode_time_per_token_ms: Optional[float] = None
@@ -96,12 +107,16 @@ class _Seq:
         max_tokens: int,
         context: Context,
         forced: Optional[List[int]] = None,
+        deadline_ms: Optional[float] = None,
     ):
         self.request_id = request_id
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.context = context
         self.arrival_ts = time.monotonic()
+        self.deadline_ts = (
+            self.arrival_ts + deadline_ms / 1000.0 if deadline_ms else None
+        )
         self.admitted_ts: Optional[float] = None
         self.first_token_ts: Optional[float] = None
         # Guided decoding: the exact token stream to emit (a grammar-valid
@@ -157,6 +172,8 @@ class MockTpuEngine:
         self.prefill_tokens_done = 0
         self.preempt_total = 0
         self.cached_tokens_total = 0  # prefix-cache hit tokens (hit-rate telemetry)
+        self.timeouts_total = 0  # deadline evictions (finish_reason "timeout")
+        self._step_n = 0  # chaos-plane step counter (worker.step site passes)
         self.last_step_ms = 0.0  # most recent simulated step duration
         self.last_step_ts: Optional[float] = None  # stall-watchdog reference
         # Same telemetry surface as the real engine (runtime/telemetry.py):
@@ -191,9 +208,13 @@ class MockTpuEngine:
         tokens: List[int] = list(request.get("token_ids") or [])
         stop = request.get("stop_conditions") or {}
         max_tokens = int(stop.get("max_tokens") or 16)
+        deadline_ms = stop.get("deadline_ms")
         self.request_total += 1
         forced = self._guided_tokens(request.get("guided_decoding"))
-        seq = _Seq(f"mock-{self.request_total}", tokens, max_tokens, context, forced=forced)
+        seq = _Seq(
+            f"mock-{self.request_total}", tokens, max_tokens, context,
+            forced=forced, deadline_ms=float(deadline_ms) if deadline_ms else None,
+        )
         self.waiting.append(seq)
         self._ensure_loop()
         self._wake.set()
@@ -202,6 +223,11 @@ class MockTpuEngine:
                 frame = await seq.out.get()
                 if frame is None:
                     return
+                if frame is _CRASH:
+                    # An injected engine crash: die like a process death —
+                    # the worker ingress drops the call-home socket and the
+                    # client observes a genuine StreamDisconnect.
+                    raise ConnectionResetError("injected worker crash")
                 yield frame
                 if frame.get("finish_reason"):
                     return
@@ -239,6 +265,21 @@ class MockTpuEngine:
         while self.waiting or self.running:
             self._reap_stopped()
             step_ms = 0.0
+            slow_factor = 1.0
+
+            # Chaos plane (runtime/faults.py): the per-step site. ``crash``
+            # kills the engine loop and severs every live stream abruptly
+            # (process-death semantics); ``hang`` wedges the loop inside
+            # afire; ``slow`` stretches this step's simulated duration.
+            if faults.armed():
+                self._step_n += 1
+                try:
+                    spec = await faults.afire("worker.step", step=self._step_n)
+                except faults.InjectedFault:
+                    self._crash_all()
+                    return
+                if spec is not None and spec.kind == "slow":
+                    slow_factor = max(spec.factor, 1.0)
 
             # Admission: a WAVE of prefill chunks per step, bounded by a
             # max_prefill_chunk token budget — mirroring the real
@@ -280,6 +321,7 @@ class MockTpuEngine:
                 # Nothing admissible (block pressure): idle-wait a tick.
                 step_ms = args.itl_base_ms
 
+            step_ms *= slow_factor
             self.last_step_ms = step_ms
             await asyncio.sleep(step_ms / 1000.0 / args.speedup_ratio)
             self.last_step_ts = time.monotonic()
@@ -309,6 +351,12 @@ class MockTpuEngine:
                     finish = "stop" if s.generated >= len(s.forced) else None
                     if finish is None and s.generated >= s.max_tokens:
                         finish = "length"
+                elif args.token_rule == "position":
+                    # token = 0-based sequence position: a migrated replay
+                    # (prompt + already-emitted tokens) continues exactly
+                    # where the dead worker stopped.
+                    token = s.total_len - 1
+                    finish = "length" if s.generated >= s.max_tokens else None
                 else:
                     token = s.tokens[s.generated % len(s.tokens)] if s.tokens else s.generated
                     finish = "length" if s.generated >= s.max_tokens else None
@@ -349,18 +397,32 @@ class MockTpuEngine:
                         return
 
     def _reap_stopped(self) -> None:
-        for s in list(self.running):
+        now = time.monotonic()
+
+        def verdict(s: _Seq) -> Optional[str]:
             if s.context.is_stopped() or s.done:
+                return "cancelled"
+            if s.deadline_ts is not None and now >= s.deadline_ts:
+                # Deadline eviction, same semantics as the real scheduler:
+                # finish_reason "timeout", blocks freed right here.
+                self.timeouts_total += 1
+                return "timeout"
+            return None
+
+        for s in list(self.running):
+            reason = verdict(s)
+            if reason is not None:
                 if not s.done:
-                    s.out.put_nowait({"token_ids": [], "finish_reason": "cancelled", "index": 0})
+                    s.out.put_nowait({"token_ids": [], "finish_reason": reason, "index": 0})
                 self._finish(s)
         for s in list(self.waiting):
-            if s.context.is_stopped() or s.done:
+            reason = verdict(s)
+            if reason is not None:
                 self.waiting.remove(s)
                 self.allocator.release(s.block_ids)
                 s.block_ids = []
                 if not s.done:
-                    s.out.put_nowait({"token_ids": [], "finish_reason": "cancelled", "index": 0})
+                    s.out.put_nowait({"token_ids": [], "finish_reason": reason, "index": 0})
 
     def _admit_chunk(self, seq: _Seq, budget: Optional[int] = None) -> int:
         """Advance one prefill chunk; returns simulated chunk tokens (0 when
@@ -471,6 +533,20 @@ class MockTpuEngine:
         self.allocator.release(seq.block_ids)
         seq.block_ids = []
 
+    def _crash_all(self) -> None:
+        """Injected engine death: sever every live stream without a final
+        frame (clients observe StreamDisconnect and migrate) and free the
+        pool — the next request restarts the sim loop, i.e. the worker
+        'process' comes back empty, exactly like a restart."""
+        logger.warning("mocker crash injected: dropping %d stream(s)",
+                       len(self.running) + len(self.waiting))
+        for s in self.running + self.waiting:
+            self.allocator.release(s.block_ids)
+            s.block_ids = []
+            s.out.put_nowait(_CRASH)
+        self.running.clear()
+        self.waiting.clear()
+
     # --- stats --------------------------------------------------------------
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
@@ -507,7 +583,11 @@ class MockTpuEngine:
             "prefix_hit_rate": round(hits / (hits + misses), 6) if (hits + misses) else 0.0,
             "preemptions_total": self.preempt_total,
             "request_total": self.request_total,
+            "request_timeouts_total": self.timeouts_total,
         }
+        # Chaos plane: injected-fault counters, same keys as the engine's
+        # scrape (only present on chaos-armed workers).
+        stats.update(faults.stats())
         # SLO/goodput account + latency digests: identical keys/shape to
         # TpuEngine.stats_handler, so the aggregator/planner/observer stack
         # can run against pure mocker fleets.

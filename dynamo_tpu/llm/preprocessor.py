@@ -146,6 +146,14 @@ class OpenAIPreprocessor(Operator):
         stop_conditions = stop_conditions_from_request(body)
         if stop_conditions.get("max_tokens") is None:
             stop_conditions["max_tokens"] = self.default_max_tokens
+        # Request deadline: client ``timeout`` (seconds; the HTTP layer
+        # injects the frontend's --request-timeout-ms default) becomes a
+        # deadline *budget* on the wire. The scheduler evicts past-deadline
+        # rows and frees their KV; the Migration operator decrements the
+        # budget across replays so a migrated request cannot out-live it.
+        timeout_s = body.get("timeout")
+        if timeout_s:
+            stop_conditions["deadline_ms"] = float(timeout_s) * 1000.0
         # Guided decoding: response_format / forced tool_choice / nvext
         # guided_* → normalized grammar spec. Unsupported or malformed
         # constraints raise RequestError here (a structured 400) — the

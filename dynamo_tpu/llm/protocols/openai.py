@@ -152,6 +152,14 @@ MAX_N = 8  # per-request choice fan-out cap (each choice is a full generation)
 
 def _validate_common_sampling(body: dict) -> None:
     _validate_guided_ext(body)
+    # Per-request deadline in seconds: the frontend turns it into a wire
+    # deadline budget (stop_conditions.deadline_ms) that the scheduler
+    # enforces by evicting past-deadline rows — expiry is a 504, not a hang.
+    to = body.get("timeout")
+    _require(
+        to is None or (isinstance(to, (int, float)) and not isinstance(to, bool) and 0 < to <= 3600),
+        "timeout must be a number of seconds in (0, 3600]",
+    )
     n = body.get("n")
     _require(
         n is None or (isinstance(n, int) and 1 <= n <= MAX_N),

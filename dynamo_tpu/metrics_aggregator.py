@@ -38,6 +38,9 @@ GAUGE_KEYS = (
     "hbm_frac_wave", "hbm_frac_spec",
     # Stall watchdog: 1.0 = step loop wedged with work queued.
     "engine_stalled", "last_step_age_s",
+    # Drain lifecycle: 1.0 while the worker is deregistered and finishing
+    # (or migrating) its in-flight work.
+    "draining",
     # Incident autopsy plane: seconds since the last black-box capture
     # (-1 = never) — the "is anything firing / did we capture it" gauge.
     "incident_last_age_s",
@@ -101,19 +104,50 @@ COUNTER_KEYS = (
     "incidents_ttft_p99_total", "incidents_tpot_p99_total",
     "incidents_queue_wait_p99_total", "incidents_slo_violation_total",
     "incidents_post_warmup_compile_total", "incidents_engine_stall_total",
-    "incidents_host_gap_total",
+    "incidents_host_gap_total", "incidents_worker_lost_total",
     "profiler_captures_total",
+    # Failure lifecycle (chaos plane, runtime/faults.py + hardened paths):
+    # deadline evictions, completed drains, and injected faults total /
+    # per kind (keys only present on chaos-armed workers).
+    "request_timeouts_total", "worker_drains_total",
+    "faults_injected_total",
+    "faults_crash_total", "faults_hang_total", "faults_stream_drop_total",
+    "faults_delay_total", "faults_partition_total", "faults_lease_drop_total",
+    "faults_stats_blackout_total", "faults_slow_total",
 )
 
 
 class MetricsAggregator:
-    def __init__(self, drt: DistributedRuntime, namespace: str, component: str, endpoint: str, interval_s: float = 2.0):
+    def __init__(self, drt: DistributedRuntime, namespace: str, component: str, endpoint: str, interval_s: float = 2.0,
+                 incident_dir: Optional[str] = None):
         self.drt = drt
         self.namespace = namespace
         self.component = component
         self.endpoint_name = endpoint
         self.interval_s = interval_s
         self.registry = MetricsRegistry(labels={"namespace": namespace, "component": component})
+        # Fleet-level incident plane: the aggregator is the one process that
+        # sees the whole instance set, so the ``worker_lost`` detector (set
+        # shrink between scrapes — a crash or lease lapse, since drains move
+        # worker_drains_total instead) lives here. Bundles attach the
+        # process's registered evidence probes — in single-process demo
+        # stacks that includes the router's routing-decision ring.
+        import os as _os
+
+        from dynamo_tpu.runtime.incidents import (
+            INCIDENT_DIR_ENV,
+            IncidentConfig,
+            IncidentPlane,
+        )
+
+        self.incidents = IncidentPlane(
+            IncidentConfig(dir=incident_dir or _os.environ.get(INCIDENT_DIR_ENV)),
+            config_probe=lambda: {
+                "role": "metrics_aggregator",
+                "endpoint": f"{namespace}/{component}/{endpoint}",
+            },
+        )
+        self._last_scrape: dict = {}
         # Fleet-merged latency digests: per-worker wire sketches merge
         # bucket-wise into TRUE fleet quantiles (averaging per-worker p99s
         # does not compose), re-exported as native Prometheus histograms +
@@ -153,6 +187,29 @@ class MetricsAggregator:
         self.digests.update_from_wire(
             s.get("digests") for s in stats.values() if isinstance(s.get("digests"), dict)
         )
+        # Fleet-level anomaly check: a shrinking instance set fires
+        # worker_lost and captures a bundle with the per-worker scrape
+        # summary + registered evidence (router decisions) attached.
+        self._last_scrape = {
+            f"{wid:x}": {
+                k: s.get(k)
+                for k in ("num_running", "num_waiting", "kv_usage", "in_flight", "draining")
+                if k in s
+            }
+            for wid, s in stats.items()
+        }
+        self.incidents.state_probe = lambda: {"last_scrape": self._last_scrape}
+        self.incidents.observe({"worker_instance_count": len(stats)})
+        plane = self.incidents.to_stats()
+        for key, help_ in (
+            ("incidents_total", "fleet-level incident captures (worker_lost et al)"),
+            ("incidents_worker_lost_total", "instance-set shrink incidents"),
+        ):
+            c = self.registry.counter(f"fleet_{key}", help_)
+            cur = float(plane[key])
+            prev = self._last.get(("fleet", key))
+            c.inc(cur if prev is None else max(cur - prev, 0.0))
+            self._last[("fleet", key)] = cur
 
     async def _loop(self) -> None:
         try:
@@ -175,7 +232,8 @@ class MetricsAggregator:
 async def amain(args) -> None:
     drt = await DistributedRuntime.from_settings()
     ns, comp, ep = args.endpoint.split("/")
-    agg = MetricsAggregator(drt, ns, comp, ep, interval_s=args.interval)
+    agg = MetricsAggregator(drt, ns, comp, ep, interval_s=args.interval,
+                            incident_dir=args.incident_dir)
     await agg.start()
     health = SystemHealth()
     health.set_system_ready()
@@ -192,6 +250,9 @@ def main() -> None:
     p.add_argument("--endpoint", required=True, help="ns/component/endpoint to scrape")
     p.add_argument("--port", type=int, default=9090)
     p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--incident-dir", default=None,
+                   help="write fleet-level (worker_lost) incident bundles here "
+                        "(default DYN_INCIDENT_DIR)")
     try:
         asyncio.run(amain(p.parse_args()))
     except KeyboardInterrupt:

@@ -24,6 +24,7 @@ from typing import Any, AsyncIterator, Callable, Dict, Optional, TYPE_CHECKING
 
 import msgpack
 
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import NULL_SPAN, get_tracer
@@ -118,14 +119,34 @@ class Component:
 class ServeHandle:
     """A running endpoint instance: owns the lease keepalive + ingress loop."""
 
-    def __init__(self, endpoint: "Endpoint", instance: Instance, lease, tasks):
+    def __init__(self, endpoint: "Endpoint", instance: Instance, lease, tasks,
+                 ingress: Optional["_PushEndpoint"] = None):
         self.endpoint = endpoint
         self.instance = instance
         self.lease = lease
         self._tasks = tasks
+        self._ingress = ingress
         self._stopped = False
 
+    @property
+    def draining(self) -> bool:
+        return self._ingress.draining if self._ingress is not None else False
+
     async def stop(self, *, drain: bool = True) -> None:
+        """The drain lifecycle (planner scale-down's primitive, and the
+        SIGTERM / POST /drain path):
+
+        1. deregister from discovery — routers prune within one watch
+           delivery and stop sending;
+        2. stop admitting — requests already queued on the pub/sub subject
+           are answered with a disconnect error, which the client's
+           Migration operator replays on a surviving worker;
+        3. finish in-flight work within ``shutdown_timeout_s`` — on
+           timeout the remaining streams are severed (task cancel drops
+           the call-home sockets without a final frame), which *migrates*
+           them instead of finishing them;
+        4. revoke the lease.
+        """
         if self._stopped:
             return
         self._stopped = True
@@ -134,7 +155,20 @@ class ServeHandle:
         await drt.store.delete(self.instance.etcd_key)
         drt.local_engines.pop(self.instance.instance_id, None)
         if drain:
-            await drt.runtime.shutdown_tracker.wait_drained(drt.runtime.config.runtime.shutdown_timeout_s)
+            if self._ingress is not None:
+                self._ingress.begin_drain()
+            drained = await drt.runtime.shutdown_tracker.wait_drained(
+                drt.runtime.config.runtime.shutdown_timeout_s
+            )
+            if not drained:
+                logger.warning(
+                    "drain of %x timed out with %d in-flight; severing streams "
+                    "(clients will migrate)",
+                    self.instance.instance_id,
+                    drt.runtime.shutdown_tracker.in_flight,
+                )
+            if self._ingress is not None:
+                self._ingress.finish_drain()
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
@@ -195,7 +229,7 @@ class Endpoint:
         # Register last: the instance only becomes routable once it can serve.
         await drt.store.put(instance.etcd_key, instance.to_json(), lease_id=lease.id)
         logger.info("serving endpoint %s as instance %x", self.path, lease.id)
-        handle = ServeHandle(self, instance, lease, tasks)
+        handle = ServeHandle(self, instance, lease, tasks, ingress=ingress)
         drt.serve_handles.append(handle)
         return handle
 
@@ -219,8 +253,22 @@ class _PushEndpoint:
         self.engine = engine
         self.graceful_shutdown = graceful_shutdown
         self.in_flight: Dict[str, Context] = {}
+        # Drain lifecycle: while draining, newly pushed requests are
+        # answered with a disconnect error (the client migrates) instead of
+        # being admitted. drains_total counts completed drains (0 or 1 for
+        # a worker process; scrape-visible while the drain runs).
+        self.draining = False
+        self.drains_total = 0
 
         self._request_tasks: set = set()
+
+    def begin_drain(self) -> None:
+        self.draining = True
+        logger.info("instance %x draining: rejecting new work, %d in-flight",
+                    self.instance.instance_id, len(self.in_flight))
+
+    def finish_drain(self) -> None:
+        self.drains_total += 1
 
     async def start(self, stats_handler=None) -> list:
         sub = await self.drt.bus.subscribe(self.instance.subject)
@@ -243,10 +291,24 @@ class _PushEndpoint:
                 # instance would stay registered but unreachable.
                 logger.warning("dropping malformed request on %s", self.instance.subject)
                 continue
-            task = asyncio.get_running_loop().create_task(self._handle(payload))
+            handler = self._reject_draining if self.draining else self._handle
+            task = asyncio.get_running_loop().create_task(handler(payload))
             # Hold a strong reference: the loop keeps only weak refs to tasks.
             self._request_tasks.add(task)
             task.add_done_callback(self._request_tasks.discard)
+
+    async def _reject_draining(self, payload: dict) -> None:
+        """A request raced the drain (queued on the subject before the
+        deregistration propagated): answer with a disconnect error so the
+        caller's Migration operator replays it on a surviving worker."""
+        conn = payload.get("conn")
+        try:
+            call_home = TcpCallHome(ConnectionInfo.from_dict(conn))
+            if await call_home.connect():
+                await call_home.error("worker draining", disconnect=True)
+                await call_home.close()
+        except (ConnectionError, TypeError, KeyError):
+            pass  # caller is gone or the payload is malformed — nothing to do
 
     async def _control_loop(self, sub) -> None:
         async for msg in sub:
@@ -254,16 +316,39 @@ class _PushEndpoint:
                 payload = msgpack.unpackb(msg.data, raw=False)
             except Exception:
                 continue
-            if payload.get("op") == "cancel":
+            op = payload.get("op")
+            if op in ("cancel", "kill"):
                 ctx = self.in_flight.get(payload.get("request_id", ""))
                 if ctx is not None:
-                    logger.info("cancel received for request %s", payload.get("request_id"))
-                    ctx.kill()
+                    logger.info("%s received for request %s", op, payload.get("request_id"))
+                    if op == "kill":
+                        # Hard abandon: the handler breaks out mid-stream.
+                        ctx.kill()
+                    else:
+                        # Graceful: the engine aborts the sequence, frees
+                        # its KV, and closes the stream with a final
+                        # finish_reason="cancelled" frame — the client
+                        # observes a clean end, not an error.
+                        ctx.stop_generating()
 
     async def _stats_loop(self, sub, stats_handler) -> None:
         async for msg in sub:
             if msg.reply_to:
-                data = {"in_flight": len(self.in_flight)}
+                if faults.armed():
+                    try:
+                        await faults.afire(
+                            "stats.reply", instance=f"{self.instance.instance_id:x}"
+                        )
+                    except faults.InjectedFault:
+                        continue  # scrape blackout: the scraper times out
+                data = {
+                    "in_flight": len(self.in_flight),
+                    # Drain lifecycle: visible on the scrape while it runs
+                    # (the planner's scale-down signal that a shrink was
+                    # coordinated, not a crash).
+                    "draining": 1.0 if self.draining else 0.0,
+                    "worker_drains_total": self.drains_total,
+                }
                 if stats_handler is not None:
                     try:
                         data.update(stats_handler() or {})
@@ -296,15 +381,33 @@ class _PushEndpoint:
             if not ok:
                 return  # caller is gone; drop the request
             try:
+                frame_i = 0
                 async for item in self.engine.generate(request, ctx):
                     if ctx.is_killed():
                         break
+                    if faults.armed():
+                        # Chaos plane, per response frame: stream_drop
+                        # raises (handled below — the socket is severed
+                        # without a final frame, a genuine mid-stream
+                        # death); hang/slow sleep inside afire.
+                        frame_i += 1
+                        await faults.afire(
+                            "worker.frame",
+                            instance=f"{self.instance.instance_id:x}",
+                            request_id=ctx.id, frame=frame_i,
+                            trace_id=getattr(ctx.traceparent, "trace_id", None),
+                        )
                     wire = item.to_wire() if isinstance(item, Annotated) else {"data": item}
                     await call_home.send(wire)
                 if ctx.is_killed():
                     await call_home.error("request cancelled")
                 else:
                     await call_home.complete()
+            except faults.InjectedFault:
+                # Injected mid-stream death: identical observable semantics
+                # to the ConnectionError branch below — no final frame, the
+                # caller sees a real StreamDisconnect and migrates.
+                logger.warning("injected stream drop for request %s; severing call-home", ctx.id)
             except ConnectionError:
                 # Engine/infrastructure death (the EngineDeadError class of
                 # failure): drop the socket without a final frame so the

@@ -90,12 +90,23 @@ class DistributedRuntime:
         (ref: transports/etcd/lease.rs keepalive loop)."""
 
         async def keepalive():
+            from dynamo_tpu.runtime import faults
+
             interval = max(lease.ttl_s / 3.0, 0.1)
             try:
                 while not lease.revoked:
                     await asyncio.sleep(interval)
                     if lease.revoked:
                         return
+                    if faults.armed():
+                        # Chaos plane: ``lease_drop`` skips renewals — the
+                        # TTL keeps ticking, the lease expires, the
+                        # instance key vanishes, and routers prune the
+                        # worker within one watch delivery.
+                        try:
+                            await faults.afire("lease.keepalive", lease=f"{lease.id:x}")
+                        except faults.InjectedFault:
+                            continue
                     try:
                         await self.store.keep_alive(lease.id)
                     except Exception:

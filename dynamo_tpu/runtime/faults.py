@@ -1,0 +1,348 @@
+"""Chaos plane: seeded, deterministic fault injection for the demo stack.
+
+The robustness features in this tree — migration replay, TTL leases,
+cancellation, drain — only matter if something can *provoke* the failures
+they claim to survive. This module is that something: a process-global
+``FaultInjector`` armed with a scenario (a list of :class:`FaultSpec`) that
+fires at named **sites** planted on the real serving paths:
+
+=====================  =====================================================
+site                   semantics (kinds it honors)
+=====================  =====================================================
+``worker.frame``       per response frame on the worker's TCP call-home
+                       (``_PushEndpoint._handle``): ``stream_drop`` severs
+                       the socket without a final frame (the client observes
+                       a genuine StreamDisconnect and migrates), ``hang``
+                       sleeps ``delay_s`` once, ``slow`` sleeps per frame.
+``worker.step``        per simulated engine step (mocker ``_sim_loop``):
+                       ``crash`` kills the engine loop — every in-flight
+                       stream drops abruptly, like a process death; ``hang``
+                       wedges the loop for ``delay_s``; ``slow`` stretches
+                       every subsequent step by ``factor``.
+``bus.publish``        the control-plane pub/sub hop: ``partition`` drops
+                       the message, ``delay`` sleeps ``delay_s`` first.
+``lease.keepalive``    the worker's lease heartbeat: ``lease_drop`` skips
+                       renewals — the lease expires, the instance key
+                       vanishes, routers prune the worker.
+``stats.reply``        the stats-scrape request/reply: ``stats_blackout``
+                       swallows the reply (the scraper times out).
+=====================  =====================================================
+
+Sites are **counted deterministically**: each ``fire()`` increments the
+site's pass counter, and a spec matches pass numbers via ``after``/``every``
+/``count`` — so a fixed scenario against a fixed workload produces the exact
+same injection sequence every run (two runs ⇒ identical ``injector.log``).
+The only randomness is the opt-in ``probability`` field, drawn from the
+injector's seeded RNG — still reproducible under a fixed seed.
+
+Every injection is recorded three ways: the ``log`` list (tests assert exact
+sequences), a ``fault`` trace event into the tracer ring (incident bundles
+capture it), and ``faults_injected_total`` / ``faults_<kind>_total``
+counters merged into the worker stats scrape (→ aggregator → Grafana).
+
+Arming is explicit and off by default: ``arm(FaultInjector(...))``,
+``--fault-scenario`` on the worker/frontend CLIs, or ``DYN_FAULTS`` (inline
+JSON or ``@/path/to/scenario.json``) for subprocess demo stacks. The
+unarmed fast path is one module-global ``is None`` check (``armed()``), so
+serving code pays nothing when chaos is off — and an armed-but-idle
+injector (no matching specs) costs one dict lookup per planted site, inside
+the observability bench's ≤2% budget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+FAULTS_ENV = "DYN_FAULTS"
+
+# The closed kind set: each is a per-kind counter on the stats wire
+# (``faults_<kind>_total`` — registered in metrics_aggregator COUNTER_KEYS
+# and pinned by the Grafana "Chaos" panel).
+KINDS = (
+    "crash",
+    "hang",
+    "stream_drop",
+    "delay",
+    "partition",
+    "lease_drop",
+    "stats_blackout",
+    "slow",
+)
+
+SITES = (
+    "worker.frame",
+    "worker.step",
+    "bus.publish",
+    "lease.keepalive",
+    "stats.reply",
+)
+
+# Kinds whose firing RAISES at the site (the others sleep or signal).
+_RAISING = frozenset({"crash", "stream_drop", "partition", "lease_drop", "stats_blackout"})
+
+
+class InjectedFault(Exception):
+    """A deliberately injected failure. Sites either let it propagate as a
+    crash or catch it to enact the kind's semantics (drop a socket, skip a
+    keepalive). Carries the spec so handlers can branch on ``kind``."""
+
+    def __init__(self, spec: "FaultSpec", attrs: Dict[str, Any]):
+        super().__init__(f"injected {spec.kind} at {spec.site}")
+        self.kind = spec.kind
+        self.spec = spec
+        self.attrs = attrs
+
+
+@dataclass
+class FaultSpec:
+    """One injection rule. Pass-count triggers (``after``/``every``/
+    ``count``) are deterministic; ``probability`` draws from the injector's
+    seeded RNG. ``match`` constrains site attributes (equality; values are
+    compared as strings so instance ids can be given in hex)."""
+
+    site: str
+    kind: str
+    after: int = 0  # skip the first N passes through the site
+    every: int = 1  # then fire on every Nth eligible pass
+    count: int = 1  # total firings (0 = unlimited)
+    match: Dict[str, Any] = field(default_factory=dict)
+    delay_s: float = 0.0  # hang/delay/slow sleep
+    factor: float = 1.0  # slow: step-time multiplier (mocker)
+    probability: float = 1.0  # <1.0: seeded coin flip per eligible pass
+    # runtime state
+    fired: int = 0
+    seen: int = 0  # eligible passes observed (post-match)
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} (sites: {SITES})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (kinds: {KINDS})")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        allowed = {"site", "kind", "after", "every", "count", "match",
+                   "delay_s", "factor", "probability"}
+        unknown = set(d) - allowed
+        if unknown:
+            raise ValueError(f"unknown fault spec fields: {sorted(unknown)}")
+        return cls(**d)
+
+    def matches(self, attrs: Dict[str, Any]) -> bool:
+        for k, want in self.match.items():
+            if k.endswith("_prefix"):
+                # e.g. {"subject_prefix": "rq."} partitions only the
+                # request-push plane, leaving stats/control alive.
+                have = attrs.get(k[: -len("_prefix")])
+                if have is None or not str(have).startswith(str(want)):
+                    return False
+                continue
+            have = attrs.get(k)
+            if have is None or str(have) != str(want):
+                return False
+        return True
+
+
+class FaultInjector:
+    """Deterministic scenario evaluator. Thread-safe: sites fire from the
+    event loop, the scheduler thread, and scrape threads alike."""
+
+    def __init__(self, scenario: Optional[List] = None, *, seed: int = 0):
+        specs: List[FaultSpec] = []
+        for s in scenario or []:
+            specs.append(s if isinstance(s, FaultSpec) else FaultSpec.from_dict(s))
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        for s in specs:
+            self._by_site.setdefault(s.site, []).append(s)
+        self.specs = specs
+        self.passes: Dict[str, int] = {}  # guarded-by: _lock
+        self.injected_total = 0  # guarded-by: _lock
+        self.by_kind: Dict[str, int] = {k: 0 for k in KINDS}  # guarded-by: _lock
+        # The injection record tests assert on: (n, site, kind, attrs).
+        self.log: List[dict] = []  # guarded-by: _lock
+
+    # --- evaluation ---------------------------------------------------------
+    def check(self, site: str, **attrs: Any) -> Optional[FaultSpec]:
+        """Count one pass through ``site`` and return the spec that fires,
+        if any (first match wins; a pass feeds every spec's counters so
+        later specs stay deterministic regardless of earlier ones)."""
+        specs = self._by_site.get(site)
+        with self._lock:
+            n = self.passes.get(site, 0) + 1
+            self.passes[site] = n
+            if not specs:
+                return None
+            hit: Optional[FaultSpec] = None
+            for s in specs:
+                if s.count and s.fired >= s.count:
+                    continue
+                if not s.matches(attrs):
+                    continue
+                s.seen += 1
+                if s.seen <= s.after:
+                    continue
+                if (s.seen - s.after - 1) % max(s.every, 1) != 0:
+                    continue
+                if s.probability < 1.0 and self._rng.random() >= s.probability:
+                    continue
+                if hit is None:
+                    hit = s
+            if hit is None:
+                return None
+            hit.fired += 1
+            self.injected_total += 1
+            self.by_kind[hit.kind] = self.by_kind.get(hit.kind, 0) + 1
+            record = {
+                "n": self.injected_total,
+                "site": site,
+                "kind": hit.kind,
+                "pass": n,
+                "attrs": {k: str(v) for k, v in attrs.items()},
+            }
+            self.log.append(record)
+        logger.warning("fault injected: %s %s (pass %d) attrs=%s",
+                       hit.kind, site, n, record["attrs"])
+        self._trace(record)
+        return hit
+
+    @staticmethod
+    def _trace(record: dict) -> None:
+        # Into the tracer (ring + export when enabled): incident bundles and
+        # trace_view timelines show the injection inline with the request
+        # lifecycle it perturbed.
+        from dynamo_tpu.runtime.tracing import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        trace_id = str(record["attrs"].get("trace_id") or "0" * 32)
+        tracer.event(
+            "fault", trace_id, service="chaos",
+            site=record["site"], kind=record["kind"], n=record["n"],
+            **{k: v for k, v in record["attrs"].items() if k != "trace_id"},
+        )
+
+    # --- stats --------------------------------------------------------------
+    def to_stats(self) -> dict:
+        """Worker-scrape counter keys (COUNTER_KEYS names)."""
+        with self._lock:
+            out = {"faults_injected_total": self.injected_total}
+            for kind in KINDS:
+                out[f"faults_{kind}_total"] = self.by_kind.get(kind, 0)
+            return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "injected_total": self.injected_total,
+                "by_kind": {k: v for k, v in self.by_kind.items() if v},
+                "log": [dict(r) for r in self.log],
+            }
+
+
+# --- process-global arming ---------------------------------------------------
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def arm(injector: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install (or, with None, remove) the process-global injector."""
+    global _INJECTOR
+    _INJECTOR = injector
+    if injector is not None:
+        logger.warning("chaos plane ARMED: %d spec(s), seed=%d",
+                       len(injector.specs), injector.seed)
+    return injector
+
+
+def disarm() -> None:
+    arm(None)
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def armed() -> bool:
+    """The unarmed fast path: call sites guard every planted site with this
+    one module-global check, so chaos-off serving pays a single ``is None``."""
+    return _INJECTOR is not None
+
+
+def arm_from_spec(spec: str, *, seed: int = 0) -> FaultInjector:
+    """Arm from inline JSON, or ``@/path`` to a JSON file. The JSON is
+    either a list of spec dicts or ``{"seed": int, "faults": [...]}``."""
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            data = json.load(f)
+    else:
+        data = json.loads(spec)
+    if isinstance(data, dict):
+        seed = int(data.get("seed", seed))
+        scenario = data.get("faults") or []
+    else:
+        scenario = data
+    return arm(FaultInjector(scenario, seed=seed))
+
+
+def maybe_arm_from_env() -> Optional[FaultInjector]:
+    """CLI entrypoints call this so subprocess demo stacks can be armed via
+    ``DYN_FAULTS`` without new flags on every binary."""
+    spec = os.environ.get(FAULTS_ENV)
+    if not spec:
+        return None
+    return arm_from_spec(spec)
+
+
+# --- site helpers -------------------------------------------------------------
+def fire(site: str, **attrs: Any) -> Optional[FaultSpec]:
+    """Synchronous site: raises :class:`InjectedFault` for raising kinds,
+    sleeps for ``hang``/``slow``/``delay``, returns the spec (callers that
+    need the ``factor``/``delay_s`` knobs read it). No-op when unarmed."""
+    inj = _INJECTOR
+    if inj is None:
+        return None
+    spec = inj.check(site, **attrs)
+    if spec is None:
+        return None
+    if spec.kind in _RAISING:
+        raise InjectedFault(spec, attrs)
+    if spec.kind in ("hang", "delay", "slow") and spec.delay_s > 0:
+        time.sleep(spec.delay_s)
+    return spec
+
+
+async def afire(site: str, **attrs: Any) -> Optional[FaultSpec]:
+    """Async site: like :func:`fire` but sleeps without blocking the loop."""
+    inj = _INJECTOR
+    if inj is None:
+        return None
+    spec = inj.check(site, **attrs)
+    if spec is None:
+        return None
+    if spec.kind in _RAISING:
+        raise InjectedFault(spec, attrs)
+    if spec.kind in ("hang", "delay", "slow") and spec.delay_s > 0:
+        await asyncio.sleep(spec.delay_s)
+    return spec
+
+
+def stats() -> dict:
+    """Injected-fault counters for a stats_handler to merge; {} when
+    unarmed (the keys only appear on chaos-armed workers)."""
+    inj = _INJECTOR
+    return inj.to_stats() if inj is not None else {}

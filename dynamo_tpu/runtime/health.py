@@ -91,10 +91,15 @@ class SystemStatusServer:
         config: Optional[SystemConfig] = None,
         state_probe: Optional[Callable[[], dict]] = None,
         profiler=None,  # runtime.profiling.DeviceProfiler
+        drain_cb: Optional[Callable[[], "asyncio.Future"]] = None,
     ):
         self.health = health
         self.metrics = metrics
         self.config = config or SystemConfig()
+        # POST /drain → the worker's drain lifecycle (deregister, stop
+        # admitting, finish-or-migrate in-flight, exit). Idempotent.
+        self.drain_cb = drain_cb
+        self._draining = False
         # Live introspection source for /debug/state (e.g.
         # TpuEngine.debug_state): running/waiting sequences, block pool,
         # digest snapshots, the recent step timeline.
@@ -115,6 +120,7 @@ class SystemStatusServer:
         app.router.add_get("/debug/state", self._debug_state)
         app.router.add_get("/debug/stacks", self._debug_stacks)
         app.router.add_post("/debug/profile", self._debug_profile)
+        app.router.add_post("/drain", self._drain)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.host, self.config.port)
@@ -194,6 +200,29 @@ class SystemStatusServer:
         status = 200 if result.get("status") == "ok" else 409 if result.get("status") == "busy" else 500
         return web.Response(
             status=status, text=json.dumps({"kind": "device", **result}),
+            content_type="application/json",
+        )
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """``POST /drain`` — begin the worker's drain lifecycle: deregister
+        from discovery, stop admitting, finish (or migrate) in-flight work
+        within shutdown_timeout_s, then exit. The planner's scale-down
+        primitive; SIGTERM takes the same path. Answers 202 immediately —
+        the drain runs in the background while /health flips notready."""
+        if self.drain_cb is None:
+            return web.Response(
+                status=404,
+                text=json.dumps({"error": "no drain hook attached"}),
+                content_type="application/json",
+            )
+        already = self._draining
+        self._draining = True
+        self.health.system_status = UNHEALTHY  # steer probes away immediately
+        if not already:
+            asyncio.get_running_loop().create_task(self.drain_cb())
+        return web.Response(
+            status=202,
+            text=json.dumps({"status": "draining", "already_draining": already}),
             content_type="application/json",
         )
 

@@ -60,6 +60,12 @@ REASONS = (
     "post_warmup_compile",
     "engine_stall",
     "host_gap",
+    # Fleet-level: the live instance set shrank between scrapes (a worker
+    # crashed or its lease lapsed). Fired by planes that observe
+    # ``worker_instance_count`` — the aggregator's fleet plane — never by a
+    # worker about itself; the key still exports as 0 on workers so the
+    # metric family is uniform.
+    "worker_lost",
 )
 
 # Which digest stream feeds each quantile-jump signal.
@@ -232,6 +238,19 @@ class AnomalyDetector:
                 self._fire("engine_stall", now, fired)
             self._last["stalled"] = stalled
 
+            # (5b) Instance-set shrink: a worker vanished between scrapes
+            # (crash / lease lapse). Any shrink fires — scale-down should
+            # drain first (worker_drains_total moves instead).
+            n_inst = stats.get("worker_instance_count")
+            if n_inst is not None:
+                n_inst = float(n_inst)
+                prev_inst = self._last.get("instances")
+                self.last_values["worker_lost"] = n_inst
+                if prev_inst is not None and n_inst < prev_inst:
+                    self.baselines["worker_lost"] = prev_inst
+                    self._fire("worker_lost", now, fired)
+                self._last["instances"] = n_inst
+
             # (5) Decode host-gap regression: mean gap over the delta.
             ev = stats.get("decode_host_gap_events_total")
             s = stats.get("decode_host_gap_seconds_total")
@@ -279,6 +298,38 @@ class AnomalyDetector:
                     r: round(self._clock() - t, 3) for r, t in self._last_fire.items()
                 },
             }
+
+
+# --- global evidence probes ---------------------------------------------------
+# Components that hold incident-relevant state but no IncidentPlane of their
+# own (the router's routing-decision ring, for one) register a probe here;
+# every bundle captured in this process attaches each probe's snapshot under
+# ``evidence.<name>``. Registration is last-writer-wins per name, so a
+# rebuilt router simply replaces its predecessor's probe.
+_EVIDENCE_PROBES: Dict[str, Callable[[], dict]] = {}
+_EVIDENCE_LOCK = threading.Lock()
+
+
+def register_evidence_probe(name: str, probe: Callable[[], dict]) -> None:
+    with _EVIDENCE_LOCK:
+        _EVIDENCE_PROBES[name] = probe
+
+
+def unregister_evidence_probe(name: str) -> None:
+    with _EVIDENCE_LOCK:
+        _EVIDENCE_PROBES.pop(name, None)
+
+
+def collect_evidence() -> Dict[str, dict]:
+    with _EVIDENCE_LOCK:
+        probes = dict(_EVIDENCE_PROBES)
+    out: Dict[str, dict] = {}
+    for name, probe in probes.items():
+        try:
+            out[name] = probe()
+        except Exception as e:  # noqa: BLE001 — a broken probe must not lose the bundle
+            out[name] = {"probe_error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def dump_thread_stacks() -> Dict[str, List[str]]:
@@ -460,6 +511,9 @@ class IncidentPlane:
             "detector": self.detector.snapshot(),
             "trace_ring": get_tracer().ring_records(),
             "thread_stacks": dump_thread_stacks(),
+            # Cross-component evidence (e.g. the router's routing-decision
+            # ring: what was being sent where just before a worker_lost).
+            "evidence": collect_evidence(),
         }
 
     def observe(self, stats: dict) -> List[str]:

@@ -6,6 +6,23 @@ Ref: lib/runtime/src/pipeline/network/egress/push_router.rs:33-275
 (two-part wire: publish request over pub/sub with TCP call-home info; response
 frames return over TCP).
 
+Failure lifecycle (this layer, not the Migration operator above it):
+
+- **Retry budget** — ``NoInstancesError`` (empty instance set, e.g. during a
+  rolling restart) is retried inside the router with jittered exponential
+  backoff up to ``RetryPolicy.max_retries`` before surfacing. The old
+  behavior surfaced immediately and the Migration operator spun on it with
+  zero backoff.
+- **Circuit breaker** — per-worker consecutive-failure tracking: a worker
+  whose streams keep dying trips OPEN and is excluded from candidate
+  selection for ``cooldown_s``; after cooldown one HALF-OPEN probe request
+  is allowed through — success closes the circuit, failure re-opens it.
+  State is lock-guarded: routes run on the event loop while stats scrapes
+  read snapshots from other threads.
+- **Prompt cancellation** — a watcher task publishes the cancel op the
+  moment the request context stops, instead of waiting for the next frame
+  to notice.
+
 The KV-aware mode lives in ``dynamo_tpu.llm.kv_router`` and wraps this router
 with a scheduler-chosen ``instance_id`` (the reference's KvPushRouter does the
 same around PushRouter.direct).
@@ -14,9 +31,13 @@ same around PushRouter.direct).
 from __future__ import annotations
 
 import asyncio
+import collections
 import enum
 import random
-from typing import Any, AsyncIterator, Optional, Set
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, Optional, Set
 
 import msgpack
 
@@ -57,6 +78,131 @@ class WorkerMonitor:
         return {i for i, u in self._usage.items() if u >= self.busy_threshold}
 
 
+@dataclass
+class RetryPolicy:
+    """NoInstances retry budget with jittered exponential backoff. ``seed``
+    pins the jitter for deterministic tests; production leaves it None."""
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.5  # fraction of each backoff randomized away
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def backoff_s(self, attempt: int) -> float:
+        base = min(self.backoff_max_s, self.backoff_base_s * (2.0 ** attempt))
+        return base * (1.0 - self.jitter * self._rng.random())
+
+
+# Circuit states (exported in snapshots; the gauge value for circuit_open).
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-worker consecutive-failure circuit.
+
+    closed --(failures >= threshold)--> open --(cooldown)--> half_open
+    half_open --(probe success)--> closed ; --(probe failure)--> open
+
+    All state behind one lock: ``record_*`` fire from the routing path on
+    the event loop while ``snapshot()`` serves stats scrapes from other
+    threads (THR001 scope)."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0,
+                 clock=time.monotonic, on_transition=None):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._on_transition = on_transition  # (instance_id, state) -> None
+        self._lock = threading.Lock()
+        # {instance_id: {"state", "failures", "opened_at", "probing"}}
+        self._w: Dict[int, dict] = {}  # guarded-by: _lock
+        self.trips_total = 0  # guarded-by: _lock
+
+    def _entry(self, wid: int) -> dict:
+        return self._w.setdefault(
+            wid, {"state": CLOSED, "failures": 0, "opened_at": 0.0, "probing": False}
+        )
+
+    def _set_state(self, wid: int, e: dict, state: str) -> None:
+        if e["state"] != state:
+            e["state"] = state
+            if self._on_transition is not None:
+                self._on_transition(wid, state)
+
+    def record_failure(self, wid: int) -> None:
+        with self._lock:
+            e = self._entry(wid)
+            e["failures"] += 1
+            e["probing"] = False
+            if e["state"] == HALF_OPEN or e["failures"] >= self.threshold:
+                if e["state"] != OPEN:
+                    self.trips_total += 1
+                    logger.warning(
+                        "circuit OPEN for worker %x (%d consecutive failures)",
+                        wid, e["failures"],
+                    )
+                self._set_state(wid, e, OPEN)
+                e["opened_at"] = self._clock()
+
+    def record_success(self, wid: int) -> None:
+        with self._lock:
+            e = self._entry(wid)
+            if e["state"] != CLOSED:
+                logger.info("circuit CLOSED for worker %x", wid)
+            e["failures"] = 0
+            e["probing"] = False
+            self._set_state(wid, e, CLOSED)
+
+    def blocked_instances(self) -> Set[int]:
+        """Workers selection must skip right now. OPEN workers whose
+        cooldown lapsed transition to HALF_OPEN here (and stop being
+        blocked until a probe claims the slot)."""
+        now = self._clock()
+        with self._lock:
+            out: Set[int] = set()
+            for wid, e in self._w.items():
+                if e["state"] == OPEN:
+                    if now - e["opened_at"] >= self.cooldown_s:
+                        self._set_state(wid, e, HALF_OPEN)
+                    else:
+                        out.add(wid)
+                        continue
+                if e["state"] == HALF_OPEN and e["probing"]:
+                    out.add(wid)  # one probe at a time
+            return out
+
+    def note_dispatch(self, wid: int) -> None:
+        """Selection chose this worker: a HALF_OPEN worker's dispatch is
+        the probe — block further routes until it resolves."""
+        with self._lock:
+            e = self._w.get(wid)
+            if e is not None and e["state"] == HALF_OPEN:
+                e["probing"] = True
+
+    def forget(self, wid: int) -> None:
+        with self._lock:
+            self._w.pop(wid, None)
+
+    def state_of(self, wid: int) -> str:
+        with self._lock:
+            e = self._w.get(wid)
+            return e["state"] if e is not None else CLOSED
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "trips_total": self.trips_total,
+                "workers": {
+                    f"{wid:x}": {"state": e["state"], "failures": e["failures"]}
+                    for wid, e in self._w.items()
+                },
+            }
+
+
 class PushRouter:
     """Routes requests to endpoint instances; returns the response stream."""
 
@@ -66,12 +212,47 @@ class PushRouter:
         mode: RouterMode = RouterMode.ROUND_ROBIN,
         *,
         monitor: Optional[WorkerMonitor] = None,
+        retry: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        metrics=None,  # optional MetricsRegistry: circuit_open{worker} gauges
     ):
         self.client = client
         self.drt = client.drt
         self.mode = mode
         self.monitor = monitor or WorkerMonitor()
+        self._metrics = metrics
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            on_transition=self._on_circuit_transition
+        )
+        self.retries_total = 0
         self._rr = 0
+        # Routing-decision black box: the evidence an incident bundle wants
+        # when a worker vanishes ("what was being sent where, just before").
+        self.decisions: collections.deque = collections.deque(maxlen=64)
+        from dynamo_tpu.runtime.incidents import register_evidence_probe
+
+        register_evidence_probe(
+            f"router:{client.endpoint.path}", self.routing_evidence
+        )
+
+    def _on_circuit_transition(self, wid: int, state: str) -> None:
+        if self._metrics is not None:
+            self._metrics.gauge(
+                "circuit_open", "per-worker circuit state (1=open, 0.5=half-open)",
+                worker=f"{wid:x}",
+            ).set(1.0 if state == OPEN else (0.5 if state == HALF_OPEN else 0.0))
+
+    def routing_evidence(self) -> dict:
+        """Recent routing decisions + breaker state (incident bundles)."""
+        return {
+            "mode": self.mode.value,
+            "endpoint": self.client.endpoint.path,
+            "live_instances": [f"{i:x}" for i in self.client.instance_ids()],
+            "recent_decisions": list(self.decisions),
+            "breaker": self.breaker.snapshot(),
+            "retries_total": self.retries_total,
+        }
 
     # --- instance selection -------------------------------------------------
     def _candidates(self) -> list[int]:
@@ -79,8 +260,15 @@ class PushRouter:
         if not ids:
             raise NoInstancesError(f"no instances for {self.client.endpoint.path}")
         busy = self.monitor.busy_instances()
-        free = [i for i in ids if i not in busy]
-        return free or ids  # all busy ⇒ degrade to full set rather than fail
+        blocked = self.breaker.blocked_instances()
+        free = [i for i in ids if i not in busy and i not in blocked]
+        if free:
+            return free
+        unblocked = [i for i in ids if i not in blocked]
+        # all busy ⇒ degrade to the unblocked set; all circuits open ⇒
+        # degrade to the full set rather than fail (availability beats
+        # breaker purity when there is nowhere else to send).
+        return unblocked or ids
 
     def select(self, instance_id: Optional[int] = None) -> int:
         if instance_id is not None:
@@ -94,6 +282,28 @@ class PushRouter:
         chosen = ids[self._rr % len(ids)]
         self._rr += 1
         return chosen
+
+    async def _select_with_retry(self, instance_id: Optional[int]) -> int:
+        """Selection behind the retry budget: an empty instance set gets
+        jittered-backoff retries (rolling restart, watch latency) before
+        NoInstancesError surfaces. Direct selects (explicit instance_id)
+        don't retry — the caller pinned a worker that is gone."""
+        attempt = 0
+        while True:
+            try:
+                return self.select(instance_id)
+            except NoInstancesError:
+                if instance_id is not None or attempt >= self.retry.max_retries:
+                    raise
+                delay = self.retry.backoff_s(attempt)
+                attempt += 1
+                self.retries_total += 1
+                logger.warning(
+                    "no instances for %s; retry %d/%d in %.0f ms",
+                    self.client.endpoint.path, attempt, self.retry.max_retries,
+                    delay * 1000.0,
+                )
+                await asyncio.sleep(delay)
 
     # --- request paths ------------------------------------------------------
     async def generate(
@@ -109,8 +319,15 @@ class PushRouter:
         the Migration operator upstream turns into a replay on another worker.
         """
         ctx = context or Context()
-        chosen = self.select(instance_id)
+        chosen = await self._select_with_retry(instance_id)
         instance = self.client.instances[chosen]
+        self.breaker.note_dispatch(chosen)
+        self.decisions.append({
+            "ts": round(time.monotonic(), 3),
+            "request_id": ctx.id,
+            "instance": f"{chosen:x}",
+            "mode": self.mode.value,
+        })
         tp = ctx.traceparent
         if tp is not None:
             get_tracer().event(
@@ -122,8 +339,13 @@ class PushRouter:
         local = self.drt.local_engines.get(chosen)
         if local is not None:
             # In-process fast path: skip pub/sub + TCP entirely.
-            async for item in self._generate_local(local, request, ctx):
-                yield item
+            try:
+                async for item in self._generate_local(local, request, ctx):
+                    yield item
+            except StreamDisconnect:
+                self.breaker.record_failure(chosen)
+                raise
+            self.breaker.record_success(chosen)
             return
 
         conn_info, pending = self.drt.tcp_server_handle().register()
@@ -133,31 +355,61 @@ class PushRouter:
         )
         await self.drt.bus.publish(instance.subject, payload)
 
-        cancelled_sent = False
+        cancel_state = {"sent": False}
+
+        async def publish_cancel() -> None:
+            if cancel_state["sent"]:
+                return
+            cancel_state["sent"] = True
+            # Two-level cancellation (ref: engine.rs AsyncEngineContext):
+            # stop_generating → graceful "cancel" (the engine frees KV and
+            # closes the stream with finish_reason=cancelled); kill → hard
+            # "kill" (the handler abandons mid-stream).
+            op = "kill" if ctx.is_killed() else "cancel"
+            await self.drt.bus.publish(
+                instance.control_subject,
+                msgpack.packb({"op": op, "request_id": ctx.id}, use_bin_type=True),
+            )
+
+        async def cancel_on_stop() -> None:
+            # Prompt propagation: a stopped context publishes the cancel op
+            # immediately — the old path only noticed at the next frame,
+            # which for a long prefill could be seconds away.
+            await ctx.stopped()
+            await publish_cancel()
+
+        watcher = asyncio.get_running_loop().create_task(cancel_on_stop())
         try:
             async for frame in pending.frames():
-                if ctx.is_stopped() and not cancelled_sent:
-                    cancelled_sent = True
-                    await self.drt.bus.publish(
-                        instance.control_subject,
-                        msgpack.packb({"op": "cancel", "request_id": ctx.id}, use_bin_type=True),
-                    )
+                if ctx.is_stopped():
+                    await publish_cancel()
                 if frame.kind == "prologue":
                     continue
                 if frame.kind == "data":
                     yield Annotated.from_wire(frame.header)
                 elif frame.kind == "complete":
+                    self.breaker.record_success(chosen)
                     return
                 elif frame.kind == "error":
                     if frame.header.get("disconnect"):
+                        # Abrupt socket death too: the TCP layer surfaces it
+                        # as a synthesized disconnect error frame.
+                        self.breaker.record_failure(chosen)
                         raise StreamDisconnect(frame.header.get("message", "disconnect"))
                     raise RuntimeError(frame.header.get("message", "engine error"))
         finally:
+            watcher.cancel()
             self.drt.tcp_server_handle().unregister(conn_info.stream_id)
 
     async def _generate_local(self, engine, request, ctx) -> AsyncIterator[Annotated]:
-        async for item in engine.generate(request, ctx):
-            yield item if isinstance(item, Annotated) else Annotated(data=item)
+        try:
+            async for item in engine.generate(request, ctx):
+                yield item if isinstance(item, Annotated) else Annotated(data=item)
+        except ConnectionError as e:
+            # In-process engines die with the same observable semantics as
+            # the wire path: a StreamDisconnect the Migration operator can
+            # replay (a raw ConnectionResetError would bubble to a 500).
+            raise StreamDisconnect(str(e) or "engine connection failure") from e
 
     # convenience wrappers matching the reference's API surface
     async def round_robin(self, request, context=None):

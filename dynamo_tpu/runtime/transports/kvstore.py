@@ -203,6 +203,15 @@ class MemKvStore(KvStore):
         async with self._lock:
             if create_only and key in self._data:
                 raise KeyExists(key)
+            prev = self._data.get(key)
+            if prev is not None and prev.lease_id is not None and prev.lease_id != lease_id:
+                # Re-binding a key to a different lease (e.g. a second worker
+                # re-registering the shared model entry): the OLD lease must
+                # stop owning it, or that worker's drain/crash would delete a
+                # key the survivor still backs.
+                old = self._leases.get(prev.lease_id)
+                if old is not None:
+                    old.keys.discard(key)
             if lease_id is not None:
                 lease = self._leases.get(lease_id)
                 if lease is None:

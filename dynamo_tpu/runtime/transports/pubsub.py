@@ -26,6 +26,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable, Dict, List, Optional, Tuple
 
+from dynamo_tpu.runtime import faults
+
 
 @dataclass
 class Message:
@@ -234,6 +236,18 @@ class MemPubSub(PubSub):
         self._lock = asyncio.Lock()
 
     async def publish(self, subject, data, headers=None, reply_to=None) -> None:
+        if faults.armed():
+            # Chaos plane: the control-plane hop. ``partition`` drops the
+            # message on the floor (the subscriber simply never hears it);
+            # ``delay`` holds delivery for delay_s. Scenario ``match``
+            # supports subject_prefix so e.g. only the request-push plane
+            # ("rq.") partitions while stats/control stay alive.
+            try:
+                await faults.afire("bus.publish", subject=subject)
+            except faults.InjectedFault as f:
+                if f.kind == "partition":
+                    return
+                raise
         msg = Message(subject=subject, data=data, headers=headers or {}, reply_to=reply_to)
         async with self._lock:
             # Group queue-group subscribers; deliver to every plain subscriber.

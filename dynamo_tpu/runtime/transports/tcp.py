@@ -212,9 +212,16 @@ class TcpCallHome:
         self._writer.write(encode_frame({"kind": "complete"}))
         await self._writer.drain()
 
-    async def error(self, message: str) -> None:
+    async def error(self, message: str, *, disconnect: bool = False) -> None:
+        """``disconnect=True`` marks the error as a stream-level disconnect
+        (worker draining, engine death): the caller raises StreamDisconnect
+        and its Migration operator may replay, instead of a terminal
+        RuntimeError."""
         assert self._writer is not None
-        self._writer.write(encode_frame({"kind": "error", "message": message}))
+        header = {"kind": "error", "message": message}
+        if disconnect:
+            header["disconnect"] = True
+        self._writer.write(encode_frame(header))
         await self._writer.drain()
 
     async def close(self) -> None:

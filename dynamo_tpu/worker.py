@@ -109,6 +109,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="watchdog: step loop idle this long with work queued => engine_stalled")
     p.add_argument("--health-port", type=int, default=None,
                    help="serve /health + /metrics + /debug/state on this port (0 = ephemeral)")
+    # Chaos plane (runtime/faults.py): deterministic fault injection for
+    # drills and the chaos test suite. Off unless armed.
+    p.add_argument("--fault-scenario", default=None,
+                   help="arm the fault injector: inline JSON or @/path/to/scenario.json "
+                        "(DYN_FAULTS env is the default)")
     return p
 
 
@@ -269,11 +274,25 @@ async def amain(args) -> None:
             profiler = DeviceProfiler()
             if incidents is not None:
                 incidents.profiler = profiler
+        async def drain_and_exit() -> None:
+            # The drain lifecycle (POST /drain; SIGTERM takes the same path
+            # through drt.shutdown → ServeHandle.stop): deregister from
+            # discovery, stop admitting, finish or migrate in-flight work
+            # within shutdown_timeout_s, flush traces, then exit.
+            logger.warning("drain requested for instance %x", worker_id)
+            health.system_status = "notready"
+            await handle.stop(drain=True)
+            from dynamo_tpu.runtime.tracing import get_tracer
+
+            get_tracer().flush()
+            drt.runtime.trigger_shutdown()
+
         status_server = SystemStatusServer(
             health,
             config=SystemConfig(enabled=True, port=args.health_port, host="0.0.0.0"),
             state_probe=getattr(engine, "debug_state", None),
             profiler=profiler,
+            drain_cb=drain_and_exit,
         )
         await status_server.start()
 
@@ -302,6 +321,12 @@ def main() -> None:
     configure_tracing(path=args.trace_file, sample=args.trace_sample,
                       service=f"worker-{args.role}",
                       ring_size=args.trace_ring, tail=args.trace_tail or None)
+    from dynamo_tpu.runtime import faults
+
+    if args.fault_scenario:
+        faults.arm_from_spec(args.fault_scenario)
+    else:
+        faults.maybe_arm_from_env()
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

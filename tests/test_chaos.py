@@ -1,0 +1,908 @@
+"""Chaos plane scenario suite: deterministic fault injection through the
+real demo stack (wire-path mocker workers + PushRouter + Migration), with
+the failure lifecycle it exposes — deadlines, retry budgets, circuit
+breaker, drain, cancellation — asserted end to end.
+
+Every scenario pins zero token loss/duplication on surviving requests
+(mocker ``token_rule="position"``: token = sequence position, so a migrated
+continuation is bit-identical to an uninterrupted run), bounded recovery,
+and KV-allocator counters back at baseline after the failure. The injector
+is seeded and pass-counted, so two runs of the same scenario produce
+identical injection logs (asserted in test_injection_determinism).
+"""
+
+import asyncio
+import glob
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.entrypoint import RouterEngine
+from dynamo_tpu.llm.migration import Migration, _MigrationEngine
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context, StreamDisconnect
+from dynamo_tpu.runtime.push_router import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    NoInstancesError,
+    PushRouter,
+    RetryPolicy,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process-global injector clean."""
+    yield
+    faults.disarm()
+
+
+def req(tokens, max_tokens=8, deadline_ms=None):
+    stop = {"max_tokens": max_tokens}
+    if deadline_ms is not None:
+        stop["deadline_ms"] = deadline_ms
+    return {"token_ids": list(tokens), "sampling_options": {}, "stop_conditions": stop}
+
+
+async def spawn_worker(drt, ep, lease_ttl_s=None, **mock_kw):
+    """One wire-path mocker worker (local fast path disabled, like a real
+    multi-process deployment)."""
+    kw = dict(speedup_ratio=50.0, num_blocks=128, token_rule="position")
+    kw.update(mock_kw)
+    engine = MockTpuEngine(MockEngineArgs(**kw))
+    handle = await ep.serve_endpoint(
+        engine.generate, stats_handler=engine.stats_handler, lease_ttl_s=lease_ttl_s
+    )
+    drt.local_engines.pop(handle.instance.instance_id)
+    return engine, handle
+
+
+async def chaos_stack(drt, ns, n_workers=2, *, migration_limit=2, on_migrate=None,
+                      retry=None, breaker=None, **mock_kw):
+    """Demo stack: N wire-path mockers behind PushRouter + Migration."""
+    ep = drt.namespace(ns).component("w").endpoint("gen")
+    workers = [await spawn_worker(drt, ep, **mock_kw) for _ in range(n_workers)]
+    client = await ep.client()
+    await client.wait_for_instances(n_workers, timeout=5)
+    router = PushRouter(
+        client,
+        retry=retry or RetryPolicy(max_retries=2, backoff_base_s=0.01, seed=0),
+        breaker=breaker,
+    )
+    engine = Migration(migration_limit, on_migrate=on_migrate).attach(RouterEngine(router))
+    return ep, client, router, engine, workers
+
+
+async def collect(engine, request, ctx=None):
+    got, finish = [], None
+    async for item in engine.generate(dict(request), ctx or Context()):
+        data = item.data if hasattr(item, "data") else item
+        if isinstance(data, dict):
+            got.extend(data.get("token_ids") or [])
+            if data.get("finish_reason"):
+                finish = data["finish_reason"]
+    return got, finish
+
+
+def assert_drained(workers):
+    """KV baseline: every allocator back to zero active blocks."""
+    for engine, _ in workers:
+        assert engine.allocator.num_active == 0, (
+            f"allocator leaked {engine.allocator.num_active} active blocks"
+        )
+
+
+# --- scenario 1: worker crash mid-stream --------------------------------------
+async def test_crash_migrates_with_zero_token_loss():
+    """Engine death after N steps: the stream drops abruptly, Migration
+    replays on the survivor, and the client sees the exact uninterrupted
+    token sequence — nothing lost, nothing duplicated."""
+    drt = await DistributedRuntime.detached()
+    migrations = []
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaos1", on_migrate=lambda: migrations.append(1))
+        faults.arm(faults.FaultInjector(
+            [{"site": "worker.step", "kind": "crash", "after": 4}], seed=7))
+
+        t0 = time.monotonic()
+        got, finish = await collect(engine, req(range(10), max_tokens=8))
+        elapsed = time.monotonic() - t0
+
+        # Position tokens: an uninterrupted run yields exactly 10..17.
+        assert got == list(range(10, 18)), got
+        assert finish == "length"
+        assert len(migrations) == 1
+        inj = faults.get_injector()
+        assert [(r["site"], r["kind"]) for r in inj.log] == [("worker.step", "crash")]
+        assert inj.to_stats()["faults_crash_total"] == 1
+        assert elapsed < 5.0, f"recovery took {elapsed:.1f}s"
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 2: stream drop after K tokens -----------------------------------
+async def test_stream_drop_after_k_tokens_migrates():
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(drt, "chaos2")
+        faults.arm(faults.FaultInjector(
+            [{"site": "worker.frame", "kind": "stream_drop", "after": 3}], seed=7))
+
+        got, finish = await collect(engine, req(range(10), max_tokens=8))
+        assert got == list(range(10, 18)), got
+        assert finish == "length"
+        log = faults.get_injector().log
+        assert [(r["site"], r["kind"]) for r in log] == [("worker.frame", "stream_drop")]
+        # The drop fired on the 4th frame: exactly 3 frames reached the wire.
+        assert log[0]["attrs"]["frame"] == "4"
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 3: worker hang → deadline eviction ------------------------------
+async def test_hang_hits_deadline_and_frees_kv():
+    """A wedged engine loop cannot hold the request past its deadline: the
+    mocker's sweep evicts with finish_reason 'timeout' and the allocator
+    returns to baseline."""
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaos3", n_workers=1, migration_limit=0, speedup_ratio=1.0,
+            itl_base_ms=5.0)
+        faults.arm(faults.FaultInjector(
+            [{"site": "worker.step", "kind": "hang", "after": 3, "delay_s": 0.6}],
+            seed=7))
+
+        t0 = time.monotonic()
+        got, finish = await collect(engine, req(range(10), max_tokens=100, deadline_ms=250))
+        elapsed = time.monotonic() - t0
+
+        assert finish == "timeout"
+        assert 0 < len(got) < 100  # some tokens streamed before the wedge
+        assert elapsed < 3.0, f"recovery took {elapsed:.1f}s"
+        mocker = workers[0][0]
+        assert mocker.timeouts_total == 1
+        assert mocker.stats_handler()["request_timeouts_total"] == 1
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 4: lease loss → eviction → migration lands on survivor ----------
+async def test_lease_loss_evicts_instance_and_migrates_once():
+    """Kill a worker mid-stream (blocked lease renewal + engine crash): the
+    router prunes the instance when the lease lapses — before the next
+    route — the Migration operator lands the replay on the survivor, and
+    migrations_total increments exactly once."""
+    drt = await DistributedRuntime.detached()
+    migrations = []
+    try:
+        ep = drt.namespace("chaos4").component("w").endpoint("gen")
+        # Victim with a short lease: expiry lands mid-stream (~0.5-1s; the
+        # stream runs ~40ms/token * 60 tokens = 2.4s sim).
+        victim, h_victim = await spawn_worker(
+            drt, ep, speedup_ratio=1.0, itl_base_ms=40.0, lease_ttl_s=0.5)
+        vid = h_victim.instance.instance_id
+        # Block the victim's lease renewals from now on (before its first
+        # ttl/3 keepalive fires).
+        faults.arm(faults.FaultInjector([
+            {"site": "lease.keepalive", "kind": "lease_drop", "count": 0,
+             "match": {"lease": f"{vid:x}"}},
+        ], seed=7))
+        survivor, h_surv = await spawn_worker(
+            drt, ep, speedup_ratio=1.0, itl_base_ms=40.0)
+        client = await ep.client()
+        await client.wait_for_instances(2, timeout=5)
+        router = PushRouter(client, retry=RetryPolicy(max_retries=2, backoff_base_s=0.01, seed=0))
+        engine = Migration(2, on_migrate=lambda: migrations.append(1)).attach(RouterEngine(router))
+        # Deterministically land the first route on the victim (lease ids
+        # are random, so pin round-robin's starting point).
+        router._rr = sorted(client.instances).index(vid)
+
+        stream_task = asyncio.create_task(collect(engine, req(range(10), max_tokens=60)))
+
+        # The router must evict the victim BEFORE the next route.
+        deadline = time.monotonic() + 5.0
+        while vid in client.instances and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert vid not in client.instances, "lease expiry did not evict the instance"
+        assert stream_task.done() is False, "stream should still be mid-flight"
+
+        # Now the 'process' dies: every live stream drops abruptly.
+        victim._crash_all()
+
+        got, finish = await stream_task
+        assert got == list(range(10, 70)), "migrated stream lost or duplicated tokens"
+        assert finish == "length"
+        assert len(migrations) == 1, f"expected exactly one migration, got {len(migrations)}"
+        # The replay landed on the survivor (only live instance).
+        assert router.decisions[-1]["instance"] == f"{h_surv.instance.instance_id:x}"
+        assert survivor.allocator.num_active == 0
+        assert any(r["kind"] == "lease_drop" for r in faults.get_injector().log)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 5: control-plane delay ------------------------------------------
+async def test_control_plane_delay_still_completes():
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(drt, "chaos5")
+        faults.arm(faults.FaultInjector([
+            {"site": "bus.publish", "kind": "delay", "count": 0, "delay_s": 0.1,
+             "match": {"subject_prefix": "rq."}},
+        ], seed=7))
+
+        t0 = time.monotonic()
+        got, finish = await collect(engine, req(range(10), max_tokens=8))
+        elapsed = time.monotonic() - t0
+        assert got == list(range(10, 18))
+        assert finish == "length"
+        assert elapsed >= 0.1  # the injected hop delay is real
+        assert elapsed < 3.0
+        assert faults.get_injector().to_stats()["faults_delay_total"] >= 1
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 6: control-plane partition + client-side deadline backstop ------
+async def test_partition_blackholes_push_then_recovers():
+    """The first request push is dropped on the floor (partition): nothing
+    ever reaches a worker, the deadline backstop cancels the wait, no KV is
+    held anywhere — and the next request sails through (count=1)."""
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(drt, "chaos6", migration_limit=0)
+        faults.arm(faults.FaultInjector([
+            {"site": "bus.publish", "kind": "partition", "count": 1,
+             "match": {"subject_prefix": "rq."}},
+        ], seed=7))
+
+        ctx = Context()
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(collect(engine, req(range(10)), ctx), timeout=0.5)
+        ctx.stop_generating()
+        await asyncio.sleep(0.05)
+        assert_drained(workers)  # the blackholed request held no blocks
+
+        got, finish = await collect(engine, req(range(10), max_tokens=8))
+        assert got == list(range(10, 18))
+        assert finish == "length"
+        assert faults.get_injector().to_stats()["faults_partition_total"] == 1
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 7: slow worker degradation --------------------------------------
+async def test_slow_worker_degrades_but_completes():
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaos7", n_workers=1, speedup_ratio=50.0)
+        faults.arm(faults.FaultInjector([
+            {"site": "worker.step", "kind": "slow", "count": 0, "factor": 5.0},
+        ], seed=7))
+
+        got, finish = await collect(engine, req(range(10), max_tokens=8))
+        assert got == list(range(10, 18))
+        assert finish == "length"
+        mocker = workers[0][0]
+        # The stretched step time is visible to telemetry (ITL digests feed
+        # the anomaly detector in production).
+        assert mocker.last_step_ms >= 5.0 * 3.0  # ≥ factor × itl_base floor
+        assert faults.get_injector().to_stats()["faults_slow_total"] >= 8
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- scenario 8: stats-scrape blackout → fleet worker_lost incident -----------
+async def test_stats_blackout_fires_worker_lost_with_router_evidence(tmp_path):
+    """A worker that stops answering scrapes vanishes from the aggregator's
+    view: the fleet incident plane fires worker_lost and the bundle carries
+    the router's routing-decision ring as evidence."""
+    from dynamo_tpu.metrics_aggregator import MetricsAggregator
+
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(drt, "chaos8")
+        # A routed request so the router's evidence ring has decisions.
+        got, _ = await collect(engine, req(range(10), max_tokens=4))
+        assert got
+
+        agg = MetricsAggregator(drt, "chaos8", "w", "gen",
+                                incident_dir=str(tmp_path / "incidents"))
+        agg.client = client
+        stats = await client.scrape_stats(timeout=0.5)
+        assert len(stats) == 2
+        agg.export_stats(stats)
+
+        blackout_id = workers[0][1].instance.instance_id
+        faults.arm(faults.FaultInjector([
+            {"site": "stats.reply", "kind": "stats_blackout", "count": 0,
+             "match": {"instance": f"{blackout_id:x}"}},
+        ], seed=7))
+        stats = await client.scrape_stats(timeout=0.5)
+        assert len(stats) == 1  # the blackout worker never replied
+        agg.export_stats(stats)
+
+        plane = agg.incidents.to_stats()
+        assert plane["incidents_worker_lost_total"] == 1
+        bundles = glob.glob(str(tmp_path / "incidents" / "incident_*worker_lost*.json"))
+        assert len(bundles) == 1
+        bundle = json.load(open(bundles[0]))
+        evidence = bundle["evidence"]
+        router_ev = next(v for k, v in evidence.items() if k.startswith("router:"))
+        assert router_ev["recent_decisions"], "bundle must carry routing decisions"
+        assert bundle["detector"]["last_values"]["worker_lost"] == 1.0
+    finally:
+        await drt.shutdown()
+
+
+# --- determinism: fixed seed ⇒ identical injection sequences ------------------
+async def test_injection_determinism_fixed_seed():
+    """Two runs of the same seeded scenario against the same workload
+    produce byte-identical injection logs (site, kind, pass, attrs)."""
+
+    async def run_once():
+        drt = await DistributedRuntime.detached()
+        try:
+            _, client, router, engine, workers = await chaos_stack(drt, "chaosd")
+            inj = faults.arm(faults.FaultInjector([
+                {"site": "worker.frame", "kind": "stream_drop", "after": 2},
+                {"site": "worker.frame", "kind": "slow", "after": 5, "count": 2,
+                 "delay_s": 0.0, "probability": 0.5},
+            ], seed=123))
+            got, finish = await collect(engine, req(range(10), max_tokens=8))
+            assert got == list(range(10, 18))
+            # Strip the timing-free identity of each injection.
+            return [(r["n"], r["site"], r["kind"], r["pass"], r["attrs"].get("frame"))
+                    for r in inj.log]
+        finally:
+            faults.disarm()
+            await drt.shutdown()
+
+    log1 = await run_once()
+    log2 = await run_once()
+    assert log1 == log2, f"injection sequences diverged:\n{log1}\n{log2}"
+    assert log1, "scenario must inject at least once"
+
+
+# --- retry budget + circuit breaker -------------------------------------------
+async def test_retry_budget_waits_out_rolling_restart():
+    """Zero instances at route time: the retry budget's backoff outlives a
+    short instance gap, and the request lands once a worker registers."""
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("chaosr").component("w").endpoint("gen")
+        client = await ep.client()
+        router = PushRouter(client, retry=RetryPolicy(max_retries=5, backoff_base_s=0.05, seed=1))
+        engine = Migration(0).attach(RouterEngine(router))
+
+        async def late_spawn():
+            await asyncio.sleep(0.1)
+            return await spawn_worker(drt, ep)
+
+        spawn_task = asyncio.create_task(late_spawn())
+        got, finish = await collect(engine, req(range(10), max_tokens=4))
+        await spawn_task
+        assert got == list(range(10, 14))
+        assert router.retries_total >= 1
+    finally:
+        await drt.shutdown()
+
+
+async def test_retry_budget_exhausts_to_no_instances():
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("chaosr2").component("w").endpoint("gen")
+        client = await ep.client()
+        router = PushRouter(client, retry=RetryPolicy(max_retries=2, backoff_base_s=0.005, seed=1))
+        with pytest.raises(NoInstancesError):
+            async for _ in router.generate(req(range(4)), Context()):
+                pass
+        assert router.retries_total == 2
+    finally:
+        await drt.shutdown()
+
+
+def test_circuit_breaker_state_machine():
+    t = [0.0]
+    cb = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    for _ in range(2):
+        cb.record_failure(7)
+    assert cb.state_of(7) == CLOSED and cb.blocked_instances() == set()
+    cb.record_failure(7)  # third consecutive → trip
+    assert cb.state_of(7) == OPEN
+    assert cb.blocked_instances() == {7}
+    t[0] = 4.9
+    assert cb.blocked_instances() == {7}  # cooldown not elapsed
+    t[0] = 5.1
+    assert cb.blocked_instances() == set()  # half-open: probe allowed
+    cb.note_dispatch(7)  # probe in flight
+    assert cb.blocked_instances() == {7}  # no second probe
+    cb.record_failure(7)  # probe failed → re-open, fresh cooldown
+    assert cb.state_of(7) == OPEN
+    t[0] = 10.3
+    assert cb.blocked_instances() == set()
+    cb.note_dispatch(7)
+    cb.record_success(7)  # probe succeeded → closed
+    assert cb.state_of(7) == CLOSED
+    assert cb.snapshot()["trips_total"] == 2
+    assert cb.snapshot()["workers"]["7"]["failures"] == 0
+
+
+async def test_breaker_trips_and_routes_around_flaky_worker():
+    """A worker whose streams keep dying trips its circuit after threshold
+    consecutive failures; subsequent requests route straight to the healthy
+    worker without paying the failure first."""
+    drt = await DistributedRuntime.detached()
+    try:
+        breaker = CircuitBreaker(threshold=2, cooldown_s=30.0)
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaosb", migration_limit=3, breaker=breaker)
+        flaky_id = workers[0][1].instance.instance_id
+        healthy_id = workers[1][1].instance.instance_id
+        faults.arm(faults.FaultInjector([
+            {"site": "worker.frame", "kind": "stream_drop", "count": 0, "every": 1,
+             "match": {"instance": f"{flaky_id:x}"}},
+        ], seed=7))
+
+        # Two requests: each first routes to the flaky worker (round-robin
+        # pinned — lease ids are random), fails, and migrates to the healthy
+        # one. Two failures trip the circuit.
+        flaky_idx = sorted(client.instances).index(flaky_id)
+        for _ in range(2):
+            router._rr = flaky_idx
+            got, finish = await collect(engine, req(range(10), max_tokens=4))
+            assert got == list(range(10, 14))
+        assert breaker.state_of(flaky_id) == OPEN
+
+        # With the circuit open, routes skip the flaky worker entirely: the
+        # injector's per-instance spec sees no more passes.
+        drops_before = faults.get_injector().to_stats()["faults_stream_drop_total"]
+        for _ in range(3):
+            got, _ = await collect(engine, req(range(10), max_tokens=4))
+            assert got == list(range(10, 14))
+            assert router.decisions[-1]["instance"] == f"{healthy_id:x}"
+        assert faults.get_injector().to_stats()["faults_stream_drop_total"] == drops_before
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- drain lifecycle ----------------------------------------------------------
+async def test_drain_finishes_in_flight_and_deregisters():
+    """POST /drain semantics (ServeHandle.stop drain path): deregister so
+    routers stop sending, finish the in-flight stream, count the drain."""
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaosdr", speedup_ratio=1.0, itl_base_ms=20.0)
+        mocker_a, handle_a = workers[0]
+        aid = handle_a.instance.instance_id
+
+        stream_task = asyncio.create_task(collect(engine, req(range(10), max_tokens=20)))
+        await asyncio.sleep(0.1)
+        assert not stream_task.done()
+
+        # Scrape mid-stream: the draining gauge flips once the drain starts.
+        drain_task = asyncio.create_task(handle_a.stop(drain=True))
+        await asyncio.sleep(0.05)
+        stats = await client.scrape_stats(timeout=0.5)
+        if aid in stats:  # stats loop alive during the drain window
+            assert stats[aid]["draining"] == 1.0
+
+        got, finish = await stream_task
+        await drain_task
+        assert got == list(range(10, 30)), "drain must not lose in-flight tokens"
+        assert finish == "length"
+        assert handle_a._ingress.drains_total == 1
+        assert aid not in client.instances
+        assert mocker_a.allocator.num_active == 0
+
+        # The drained worker is gone from routing: new work lands elsewhere.
+        got, _ = await collect(engine, req(range(10), max_tokens=4))
+        assert got == list(range(10, 14))
+        assert router.decisions[-1]["instance"] == f"{workers[1][1].instance.instance_id:x}"
+    finally:
+        await drt.shutdown()
+
+
+async def test_drain_timeout_migrates_in_flight_work():
+    """A drain that cannot finish within shutdown_timeout_s severs the
+    remaining streams — which migrates them: the client still sees the
+    complete, uninterrupted token sequence."""
+    drt = await DistributedRuntime.detached()
+    try:
+        drt.runtime.config.runtime.shutdown_timeout_s = 0.2
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaosdm", speedup_ratio=1.0, itl_base_ms=30.0)
+        mocker_a, handle_a = workers[0]
+
+        stream_task = asyncio.create_task(collect(engine, req(range(10), max_tokens=40)))
+        await asyncio.sleep(0.15)
+        assert not stream_task.done()
+        await handle_a.stop(drain=True)  # 0.2s budget ≪ ~1.2s of stream left
+
+        got, finish = await stream_task
+        assert got == list(range(10, 50)), "severed stream must migrate losslessly"
+        assert finish == "length"
+        assert workers[1][0].allocator.num_active == 0
+    finally:
+        await drt.shutdown()
+
+
+async def test_draining_worker_rejects_new_pushes_to_migration():
+    """A request that races the drain window (stale route) is answered with
+    a disconnect error, and Migration replays it on a live worker."""
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("chaosdj").component("w").endpoint("gen")
+        mock_a, handle_a = await spawn_worker(drt, ep)
+        mock_b, handle_b = await spawn_worker(drt, ep)
+        # A STALE client: still believes both instances exist (the race).
+        stale = await ep.client()
+        await stale.wait_for_instances(2, timeout=5)
+        handle_a._ingress.begin_drain()  # drain begun; key deletion pending
+
+        router = PushRouter(stale, retry=RetryPolicy(seed=0))
+        engine = Migration(2).attach(RouterEngine(router))
+        got, finish = await collect(engine, req(range(10), max_tokens=6))
+        assert got == list(range(10, 16))
+        assert finish == "length"
+        assert router.decisions[-1]["instance"] == f"{handle_b.instance.instance_id:x}"
+    finally:
+        await drt.shutdown()
+
+
+# --- cancellation propagation -------------------------------------------------
+async def test_cancellation_mid_stream_frees_kv_blocks():
+    """Client stop mid-stream → prompt cancel over the control subject →
+    mocker reaps the sequence → allocator back to baseline (prefix-cache
+    refcounts released)."""
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaosc", n_workers=1, speedup_ratio=1.0, itl_base_ms=20.0)
+        mocker = workers[0][0]
+        ctx = Context()
+        got = []
+        async for item in engine.generate(req(range(64), max_tokens=100), ctx):
+            data = item.data if hasattr(item, "data") else item
+            if isinstance(data, dict) and data.get("token_ids"):
+                got.extend(data["token_ids"])
+                if len(got) >= 2:
+                    ctx.stop_generating()
+            if isinstance(data, dict) and data.get("finish_reason"):
+                assert data["finish_reason"] == "cancelled"
+                break
+
+        deadline = time.monotonic() + 3.0
+        while mocker.allocator.num_active and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert mocker.allocator.num_active == 0, "cancelled request leaked KV blocks"
+        assert not mocker.running and not mocker.waiting
+        assert 2 <= len(got) < 100
+    finally:
+        await drt.shutdown()
+
+
+# --- deadline eviction on the REAL scheduler ----------------------------------
+def test_scheduler_deadline_evicts_and_frees_blocks():
+    """Real TpuEngine scheduler: a past-deadline row (waiting or running) is
+    evicted with finish_reason 'timeout', its KV freed, while batchmates
+    finish untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    sched = Scheduler(cfg, params, SchedulerConfig(
+        num_blocks=64, prefill_buckets=[16, 32, 64], decode_buckets=[1, 2, 4],
+        enable_prefix_caching=False,
+    ), dtype=jnp.float32)
+
+    # r0: normal. r1: deadline already lapsed at arrival → evicted from the
+    # waiting queue before any prefill, holding zero blocks.
+    sched.add_request("r0", list(range(1, 33)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=8))
+    sched.add_request("r1", list(range(2, 34)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=8, deadline_ms=0.001))
+    produced = {}
+    for _ in range(200):
+        if not sched.has_work():
+            break
+        for seq, out in sched.step():
+            produced.setdefault(seq.request_id, []).append(out)
+    assert not sched.has_work()
+    assert produced["r1"][-1].finish_reason == "timeout"
+    assert [o.token_id for o in produced["r1"] if o.token_id >= 0] == []
+    assert produced["r0"][-1].finish_reason in ("stop", "length")
+    assert len([o for o in produced["r0"] if o.token_id >= 0]) == 8
+    assert sched.timeouts_total == 1
+    assert sched.allocator.num_active == 0
+
+    # Mid-decode expiry: run a few steps, then lapse the deadline by hand
+    # (deterministic — no wall-clock race) and prove the running row's
+    # blocks come back.
+    # Long budget: multi-step decode windows can retire many tokens per
+    # step() call, so keep max_tokens far above what 3 calls can finish.
+    sched.add_request("r2", list(range(3, 35)), SamplingParams(temperature=0.0),
+                      StopConditions(max_tokens=800, deadline_ms=60_000.0))
+    for _ in range(3):
+        sched.step()
+    seq = sched.by_id["r2"]
+    assert seq.block_ids, "r2 should hold KV blocks mid-decode"
+    seq.deadline_ts = 0.0  # already past
+    out = None
+    for _ in range(10):
+        stepped = sched.step()
+        if stepped:
+            out = stepped[-1][1]
+            break
+    assert out is not None and out.finish_reason == "timeout"
+    assert sched.timeouts_total == 2
+    assert sched.allocator.num_active == 0
+
+
+# --- migration fold accounting (satellite) ------------------------------------
+def test_migration_fold_decrements_budgets_and_clamps_cached():
+    folded = _MigrationEngine._fold(
+        {"token_ids": [1, 2, 3],
+         "stop_conditions": {"max_tokens": 10, "deadline_ms": 1000.0}},
+        [7, 8], time.monotonic() - 0.2,  # 200 ms already elapsed
+    )
+    assert folded["token_ids"] == [1, 2, 3, 7, 8]
+    assert folded["stop_conditions"]["max_tokens"] == 8
+    # Deadline budget shrank by the elapsed time (±scheduling slop).
+    assert folded["stop_conditions"]["deadline_ms"] == pytest.approx(800.0, abs=100.0)
+
+    # Folding again keeps decrementing against the ORIGINAL budget.
+    folded2 = _MigrationEngine._fold(folded, [9], time.monotonic() - 0.5)
+    assert folded2["stop_conditions"]["max_tokens"] == 7
+    assert folded2["stop_conditions"]["deadline_ms"] == pytest.approx(500.0, abs=100.0)
+
+    # cached_tokens honesty: a replay's warm hit covering prompt+folded
+    # output clamps to the original prompt; duplicates are swallowed.
+    out = {"token_ids": [5], "cached_tokens": 5}
+    item = _MigrationEngine._honest_cached(out, out, orig_prompt_len=3,
+                                           already_reported=False)
+    assert item["cached_tokens"] == 3
+    dup = {"token_ids": [], "cached_tokens": 3}
+    assert _MigrationEngine._honest_cached(dup, dup, 3, already_reported=True) is None
+
+
+async def test_migration_exhausted_metadata_carries_partial_count():
+    """Exhausted migration annotates the context with the partial token
+    count — what the frontend's structured 502 reports."""
+    drt = await DistributedRuntime.detached()
+    try:
+        _, client, router, engine, workers = await chaos_stack(drt, "chaosx", migration_limit=1)
+        faults.arm(faults.FaultInjector([
+            {"site": "worker.frame", "kind": "stream_drop", "count": 0, "after": 2},
+        ], seed=7))
+        ctx = Context()
+        got = []
+        with pytest.raises(StreamDisconnect):
+            async for item in engine.generate(req(range(10), max_tokens=8), ctx):
+                data = item.data if hasattr(item, "data") else item
+                if isinstance(data, dict):
+                    got.extend(data.get("token_ids") or [])
+        # Attempt 1 streams 2 frames before the drop; the replay's pass
+        # counter is already past `after`, so it drops on its first frame.
+        assert ctx.metadata["migration"]["tokens_emitted"] == len(got) == 2
+        assert ctx.metadata["migration"]["attempts"] == 1
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+# --- HTTP frontend failure mapping --------------------------------------------
+async def _http_service(manager):
+    from dynamo_tpu.llm.http.service import HttpService
+
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service
+
+
+async def test_http_503_with_retry_after_when_no_instances():
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+
+    class NoWorkersEngine:
+        availability_probe = staticmethod(lambda: 0)
+
+        def generate(self, request, context):  # pragma: no cover — never routed
+            raise AssertionError("must not be called")
+
+    manager = ModelManager()
+    manager.add_model("chat", "m", NoWorkersEngine())
+    service = await _http_service(manager)
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                assert r.status == 503
+                assert r.headers["Retry-After"] == "1"
+                data = await r.json()
+                assert data["error"]["type"] == "service_unavailable"
+    finally:
+        await service.stop()
+
+
+async def test_http_504_deadline_with_partial_usage():
+    """Client ``timeout`` rides the wire as a deadline budget; the mocker
+    evicts at expiry and the unary answer is a 504 carrying the partial
+    token count in usage."""
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    mock = MockTpuEngine(MockEngineArgs(
+        speedup_ratio=1.0, itl_base_ms=60.0, num_blocks=128))
+    manager = ModelManager()
+    manager.add_model("chat", "m", build_local_pipeline(ByteTokenizer(), mock))
+    service = await _http_service(manager)
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 100, "timeout": 0.4}
+            t0 = time.monotonic()
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                elapsed = time.monotonic() - t0
+                assert r.status == 504, await r.text()
+                data = await r.json()
+                assert data["error"]["type"] == "timeout_error"
+                assert 0 < data["usage"]["completion_tokens"] < 100, data["usage"]
+                assert elapsed < 3.0
+        assert mock.timeouts_total == 1
+        assert mock.allocator.num_active == 0
+        # Bad timeout values are structured 400s.
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+                    "timeout": -1}
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                assert r.status == 400
+    finally:
+        await service.stop()
+
+
+async def test_http_504_watchdog_on_hung_worker():
+    """A worker that never produces a frame cannot hold the client past the
+    deadline: the frontend's own watchdog answers 504."""
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    class HungEngine:
+        async def generate(self, request, context):
+            await asyncio.sleep(600)
+            yield {}
+
+    manager = ModelManager()
+    manager.add_model("chat", "m", build_local_pipeline(ByteTokenizer(), HungEngine()))
+    service = await _http_service(manager)
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4, "timeout": 0.3}
+            t0 = time.monotonic()
+            async with s.post(f"http://127.0.0.1:{service.port}/v1/chat/completions", json=body) as r:
+                elapsed = time.monotonic() - t0
+                assert r.status == 504
+                data = await r.json()
+                assert data["usage"]["completion_tokens"] == 0
+            # deadline (0.3) + grace (0.5) + bounded reap — not 600s.
+            assert elapsed < 5.0
+    finally:
+        await service.stop()
+
+
+async def test_http_502_on_exhausted_migration_with_partial_tokens():
+    import aiohttp
+
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.entrypoint import build_routed_pipeline
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("chaoshttp").component("w").endpoint("gen")
+        await spawn_worker(drt, ep)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client, retry=RetryPolicy(seed=0))
+        pipeline = build_routed_pipeline(ByteTokenizer(), router, migration_limit=1)
+        manager = ModelManager()
+        manager.add_model("completions", "m", pipeline)
+        service = await _http_service(manager)
+        # Every attempt drops after 2 frames.
+        faults.arm(faults.FaultInjector([
+            {"site": "worker.frame", "kind": "stream_drop", "count": 0, "after": 2},
+        ], seed=7))
+        try:
+            async with aiohttp.ClientSession() as s:
+                body = {"model": "m", "prompt": "hello", "max_tokens": 8}
+                async with s.post(f"http://127.0.0.1:{service.port}/v1/completions", json=body) as r:
+                    assert r.status == 502, await r.text()
+                    data = await r.json()
+                    assert data["error"]["type"] == "bad_gateway"
+                    assert data["error"]["partial_tokens"] == 2
+                    assert data["error"]["migrations"] == 1
+        finally:
+            await service.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_model_survives_drain_of_one_backing_worker():
+    """Two workers register the same model (per-instance model keys): the
+    frontend watcher refcounts, so draining one worker must NOT drop the
+    model — scale-down leaves the survivors serving."""
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.entrypoint import register_llm
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("chaosmw").component("w").endpoint("gen")
+        card = ModelDeploymentCard(name="m", model_type="chat", kv_cache_block_size=16)
+        mock_a = MockTpuEngine(MockEngineArgs())
+        mock_b = MockTpuEngine(MockEngineArgs())
+        handle_a, _ = await register_llm(drt, ep, mock_a, card,
+                                         stats_handler=mock_a.stats_handler)
+        handle_b, _ = await register_llm(drt, ep, mock_b, card,
+                                         stats_handler=mock_b.stats_handler)
+
+        manager = ModelManager()
+        built = []
+
+        async def factory(entry):
+            built.append(entry.name)
+
+            class _E:
+                async def generate(self, request, context):
+                    yield {}
+
+            return _E()
+
+        watcher = ModelWatcher(drt, manager, factory)
+        await watcher.start()
+        assert manager.get("chat", "m") is not None
+        assert built == ["m"]  # one pipeline, refcounted across both workers
+
+        await handle_a.stop(drain=True)
+        await asyncio.sleep(0.1)
+        assert manager.get("chat", "m") is not None, (
+            "draining one of two same-model workers dropped the model"
+        )
+        await handle_b.stop(drain=True)
+        for _ in range(50):
+            if manager.get("chat", "m") is None:
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("chat", "m") is None, "last worker gone ⇒ model removed"
+        await watcher.stop()
+    finally:
+        await drt.shutdown()

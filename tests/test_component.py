@@ -100,7 +100,9 @@ async def test_instance_removed_on_lease_loss():
         await drt.shutdown()
 
 
-async def test_cancellation_kills_inflight_request():
+async def test_cancellation_stops_inflight_request_gracefully():
+    """stop_generating → graceful 'cancel' op: the handler observes the
+    stopped context, finishes cleanly, and the client stream simply ends."""
     drt = await make_drt()
     started = asyncio.Event()
     progressed = []
@@ -110,7 +112,7 @@ async def test_cancellation_kills_inflight_request():
         async def slow_handler(request, context):
             started.set()
             for i in range(1000):
-                if context.is_killed():
+                if context.is_stopped():
                     return
                 progressed.append(i)
                 yield {"i": i}
@@ -124,11 +126,43 @@ async def test_cancellation_kills_inflight_request():
 
         ctx = Context()
         got = []
+        async for a in router.generate({}, ctx):
+            got.append(a.data)
+            if len(got) == 3:
+                ctx.stop_generating()
+        assert len(progressed) < 1000
+    finally:
+        await drt.shutdown()
+
+
+async def test_kill_abandons_inflight_request():
+    """kill → hard 'kill' op: the worker-side handler breaks mid-stream and
+    the client sees the cancellation error."""
+    drt = await make_drt()
+    progressed = []
+    try:
+        ep = drt.namespace("test").component("comp").endpoint("gen")
+
+        async def oblivious_handler(request, context):
+            # Ignores the context entirely: only the hard kill can stop it.
+            for i in range(1000):
+                progressed.append(i)
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        handle = await ep.serve_endpoint(oblivious_handler)
+        drt.local_engines.pop(handle.instance.instance_id)  # use wire path
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        router = PushRouter(client)
+
+        ctx = Context()
+        got = []
         with pytest.raises(RuntimeError):
             async for a in router.generate({}, ctx):
                 got.append(a.data)
                 if len(got) == 3:
-                    ctx.stop_generating()
+                    ctx.kill()
         assert len(progressed) < 1000
     finally:
         await drt.shutdown()

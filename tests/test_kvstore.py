@@ -93,3 +93,20 @@ async def test_lease_keepalive_preserves_keys():
     await lease.revoke()
     assert await store.get("inst/b") is None
     await store.close()
+
+
+async def test_shared_key_rebinds_to_newest_lease():
+    """A key re-put under a different lease (two workers registering the
+    same model entry) must belong to the NEWEST lease only: revoking or
+    draining the old worker cannot delete a key the survivor still backs."""
+    store = MemKvStore()
+    a = await store.grant_lease(10.0)
+    b = await store.grant_lease(10.0)
+    await store.put("models/ns/c/e/m", b"worker-a", lease_id=a.id)
+    await store.put("models/ns/c/e/m", b"worker-b", lease_id=b.id)
+    await store.revoke_lease(a.id)
+    entry = await store.get("models/ns/c/e/m")
+    assert entry is not None and entry.value == b"worker-b"
+    await store.revoke_lease(b.id)
+    assert await store.get("models/ns/c/e/m") is None
+    await store.close()
