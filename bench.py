@@ -1262,6 +1262,53 @@ def bench_guided_overhead():
     }
 
 
+def bench_autoscale():
+    """Closed-loop SLA autoscaling under the million-user traffic harness
+    (tools/traffic_harness.py): a seeded diurnal ramp with drifting ISL
+    drives a real in-process plane — mocker pools → metrics aggregator
+    (multi-endpoint scrape) → Prometheus observer → AutoscaleController →
+    fleet launches/drains — with a chaos crash armed the moment the first
+    scale event lands. Reports the SLO-attainment + goodput curves across
+    the ramp, the scale timeline, and convergence vs the capacity oracle.
+    CI asserts: converged (final pools within ±1 of the oracle), SLO
+    attainment above the floor, chaos fired, zero token loss."""
+    import asyncio
+
+    from tools.traffic_harness import (
+        AutoscaleBenchConfig,
+        TrafficPattern,
+        run_autoscale_bench,
+    )
+
+    cfg = AutoscaleBenchConfig(
+        pattern=TrafficPattern(
+            kind="diurnal", duration_s=float(os.environ.get("BENCH_AUTOSCALE_S", "20")),
+            base_rate=1.5, peak_rate=8.0, isl=96, isl_end=144, osl=16,
+            prefix_ratio=0.5, seed=0,
+        ),
+        adjustment_interval_s=1.5,
+        scale_cooldown_s=3.0,
+        settle_s=5.0,
+    )
+    report = asyncio.run(run_autoscale_bench(cfg))
+    planner = report["planner"]
+    report["summary"] = {
+        "converged": report["final"]["converged"],
+        "final_pools": {"prefill": report["final"]["prefill"],
+                        "decode": report["final"]["decode"]},
+        "oracle_pools": {"prefill": report["final"]["oracle_prefill"],
+                         "decode": report["final"]["oracle_decode"]},
+        "slo_attainment": report["slo_attainment"],
+        "slo_floor": 0.7,
+        "token_loss": report["totals"]["token_loss"],
+        "errors": report["totals"]["errors"],
+        "chaos_injections": report["chaos"]["injections"],
+        "scale_ups": planner["planner_scale_up_total"],
+        "scale_downs": planner["planner_scale_down_total"],
+    }
+    return report
+
+
 # --------------------------------------------------------------------------
 # child: run sections against the already-chosen backend, emit partials
 # --------------------------------------------------------------------------
@@ -1684,6 +1731,25 @@ def child_main() -> None:
     else:
         errors.append("guided_overhead skipped: budget")
 
+    # --- closed-loop autoscaling (traffic harness, CPU subprocess) ----------
+    autoscale = None
+    if remaining() > 60:
+        try:
+            autoscale, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "summary",
+                max(60, remaining() - 10), extra_env={"BENCH_AUTOSCALE_ONLY": "1"},
+            )
+            if autoscale is None:
+                errors.append(f"autoscale: {err}")
+            else:
+                _emit_partial("autoscale", autoscale)
+        except subprocess.TimeoutExpired:
+            errors.append("autoscale: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"autoscale: {type(e).__name__}: {e}")
+    else:
+        errors.append("autoscale skipped: budget")
+
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
                               cpu_fallback, errors, tpu_http=tpu_http,
                               router_prefix=router_prefix, large_model=large_detail,
@@ -1692,10 +1758,11 @@ def child_main() -> None:
                               guided_overhead=guided_overhead,
                               decode_overlap=decode_overlap,
                               prefix_reuse=prefix_reuse,
-                              decode_attention=decode_attention)), flush=True)
+                              decode_attention=decode_attention,
+                              autoscale=autoscale)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None, autoscale=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -1726,6 +1793,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "observability": observability,
             "guided_overhead": guided_overhead,
             "decode_overlap": decode_overlap,
+            "autoscale": autoscale,
             "device": device,
             "cpu_fallback": cpu_fallback,
             "errors": errors,
@@ -1857,6 +1925,7 @@ def main() -> None:
             decode_overlap=partials.get("decode_overlap"),
             prefix_reuse=partials.get("prefix_reuse"),
             decode_attention=partials.get("decode_attention"),
+            autoscale=partials.get("autoscale"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
     final["detail"]["wall_s"] = round(time.time() - t_start, 1)
@@ -1897,6 +1966,13 @@ if __name__ == "__main__":
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_guided_overhead()), flush=True)
+    elif os.environ.get("BENCH_AUTOSCALE_ONLY") == "1":
+        # CPU-pinned: the subject is the closed planner loop over mocker
+        # fleets (scheduler/aggregator/controller structure), not a device.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_autoscale()), flush=True)
     elif os.environ.get("BENCH_OBS_ONLY") == "1":
         # CPU-pinned: measures the tracing layer's host-side cost, which a
         # device tunnel's dispatch latency would drown out.

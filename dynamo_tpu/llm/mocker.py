@@ -108,11 +108,17 @@ class _Seq:
         context: Context,
         forced: Optional[List[int]] = None,
         deadline_ms: Optional[float] = None,
+        prefill_done: bool = False,
     ):
         self.request_id = request_id
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.context = context
+        # Disaggregated decode leg: the prompt's KV "arrived by transfer"
+        # (the real scheduler's disagg_inject) — blocks are allocated but
+        # no prefill compute is simulated and no prefix is matched or
+        # registered (transferred KV is not reuse).
+        self.prefill_done = prefill_done
         self.arrival_ts = time.monotonic()
         self.deadline_ts = (
             self.arrival_ts + deadline_ms / 1000.0 if deadline_ms else None
@@ -173,6 +179,12 @@ class MockTpuEngine:
         self.preempt_total = 0
         self.cached_tokens_total = 0  # prefix-cache hit tokens (hit-rate telemetry)
         self.timeouts_total = 0  # deadline evictions (finish_reason "timeout")
+        # Traffic-shape counters: the planner's observer derives request
+        # rate and avg ISL/OSL from these when no frontend is in the path
+        # (pure mocker fleets under the traffic harness).
+        self.input_tokens_total = 0
+        self.output_tokens_total = 0
+        self.disagg_prefill_done_total = 0  # decode legs admitted with transferred KV
         self._step_n = 0  # chaos-plane step counter (worker.step site passes)
         self.last_step_ms = 0.0  # most recent simulated step duration
         self.last_step_ts: Optional[float] = None  # stall-watchdog reference
@@ -210,10 +222,16 @@ class MockTpuEngine:
         max_tokens = int(stop.get("max_tokens") or 16)
         deadline_ms = stop.get("deadline_ms")
         self.request_total += 1
+        if not request.get("prefill_done"):
+            # Disagg decode legs carry the prompt for context accounting but
+            # prefill none of it — counting their input tokens would double
+            # the observer's prefill-demand estimate (rate × ISL).
+            self.input_tokens_total += len(tokens)
         forced = self._guided_tokens(request.get("guided_decoding"))
         seq = _Seq(
             f"mock-{self.request_total}", tokens, max_tokens, context,
             forced=forced, deadline_ms=float(deadline_ms) if deadline_ms else None,
+            prefill_done=bool(request.get("prefill_done")),
         )
         self.waiting.append(seq)
         self._ensure_loop()
@@ -343,6 +361,7 @@ class MockTpuEngine:
                     self._finish(s)
                     continue
                 s.generated += 1
+                self.output_tokens_total += 1
                 if s.forced is not None:
                     # Guided: emit the grammar-valid stream; "stop" on the
                     # final token (the FSM accepted), "length" if max_tokens
@@ -433,6 +452,24 @@ class MockTpuEngine:
         token budget across admitted sequences)."""
         args = self.args
         bs = args.block_size
+        if seq.computed == 0 and not seq.block_ids and seq.prefill_done and seq.recompute == 0:
+            # Disagg decode leg: KV for the whole prompt was transferred in.
+            # Allocate the blocks it occupies, skip the prefill simulation
+            # entirely, and leave the prefix cache untouched (transferred
+            # blocks are private — counting them as cache hits would
+            # poison the router's warmth accounting). After a preemption
+            # the transferred KV is gone and the normal recompute path runs.
+            needed = (seq.total_len + 1 + bs - 1) // bs
+            if not self._allocate(seq, needed, preempt=False):
+                return 0
+            seq.computed = seq.prefill_span
+            self.disagg_prefill_done_total += 1
+            if seq.admitted_ts is None:
+                seq.admitted_ts = time.monotonic()
+                self.telemetry.observe(
+                    "queue_wait", max(0.0, seq.admitted_ts - seq.arrival_ts)
+                )
+            return 0
         if seq.computed == 0 and not seq.block_ids:
             seq.hashes = compute_block_hashes(seq.tokens, bs)
             matched = self.allocator.match_prefix(seq.hashes)
@@ -581,9 +618,19 @@ class MockTpuEngine:
             "kv_free_blocks": len(a._free),
             "kv_cached_blocks": a.num_cached,
             "prefix_hit_rate": round(hits / (hits + misses), 6) if (hits + misses) else 0.0,
+            # KV warmth: fraction of the pool holding registered (reusable)
+            # prefix KV — the engine-side half of the planner's
+            # coldest-worker scale-down signal.
+            "kv_warmth": round(a.num_cached / a.num_blocks, 6) if a.num_blocks else 0.0,
             "preemptions_total": self.preempt_total,
             "request_total": self.request_total,
             "request_timeouts_total": self.timeouts_total,
+            # Traffic shape for the observer (rate = Δrequest_total/Δt,
+            # ISL/OSL = token deltas per request delta) on frontend-less
+            # mocker fleets.
+            "input_tokens_total": self.input_tokens_total,
+            "output_tokens_total": self.output_tokens_total,
+            "disagg_prefill_done_total": self.disagg_prefill_done_total,
         }
         # Chaos plane: injected-fault counters, same keys as the engine's
         # scrape (only present on chaos-armed workers).

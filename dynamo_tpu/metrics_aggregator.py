@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-from typing import Optional
+from typing import Optional, Sequence
 
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.health import SystemHealth, SystemStatusServer, HEALTHY
@@ -41,6 +41,13 @@ GAUGE_KEYS = (
     # Drain lifecycle: 1.0 while the worker is deregistered and finishing
     # (or migrating) its in-flight work.
     "draining",
+    # KV warmth: fraction of the worker's KV pool holding registered
+    # (reusable) prefix blocks — the engine-side half of the planner's
+    # coldest-worker scale-down ranking.
+    "kv_warmth",
+    # Planner (autoscale controller) targets + mode, scraped from the
+    # planner's own stats endpoint (planner/fleet.py serve_planner).
+    "planner_prefill_target", "planner_decode_target", "planner_dry_run",
     # Incident autopsy plane: seconds since the last black-box capture
     # (-1 = never) — the "is anything firing / did we capture it" gauge.
     "incident_last_age_s",
@@ -110,6 +117,15 @@ COUNTER_KEYS = (
     # deadline evictions, completed drains, and injected faults total /
     # per kind (keys only present on chaos-armed workers).
     "request_timeouts_total", "worker_drains_total",
+    # Traffic shape (mocker fleets / frontend-less stacks): the planner's
+    # observer derives request rate and avg ISL/OSL from these deltas.
+    "input_tokens_total", "output_tokens_total", "disagg_prefill_done_total",
+    # Autoscale controller decisions (planner/controller.py to_stats):
+    # actions taken and the anti-flap gates that suppressed them.
+    "planner_decisions_total",
+    "planner_scale_up_total", "planner_scale_down_total",
+    "planner_hysteresis_suppressed_total", "planner_cooldown_suppressed_total",
+    "planner_drain_debounced_total",
     "faults_injected_total",
     "faults_crash_total", "faults_hang_total", "faults_stream_drop_total",
     "faults_delay_total", "faults_partition_total", "faults_lease_drop_total",
@@ -119,11 +135,15 @@ COUNTER_KEYS = (
 
 class MetricsAggregator:
     def __init__(self, drt: DistributedRuntime, namespace: str, component: str, endpoint: str, interval_s: float = 2.0,
-                 incident_dir: Optional[str] = None):
+                 incident_dir: Optional[str] = None, extra_endpoints: Sequence[str] = ()):
         self.drt = drt
         self.namespace = namespace
         self.component = component
         self.endpoint_name = endpoint
+        # Additional ``ns/component/endpoint`` paths scraped into the same
+        # registry — a disaggregated deployment's prefill + decode pools
+        # (plus the planner's stats endpoint) aggregate in one process.
+        self.extra_endpoints = list(extra_endpoints)
         self.interval_s = interval_s
         self.registry = MetricsRegistry(labels={"namespace": namespace, "component": component})
         # Fleet-level incident plane: the aggregator is the one process that
@@ -161,6 +181,11 @@ class MetricsAggregator:
     async def start(self) -> None:
         ep = self.drt.namespace(self.namespace).component(self.component).endpoint(self.endpoint_name)
         self.client = await ep.client()
+        self.extra_clients = []
+        for path in self.extra_endpoints:
+            ns, comp, name = path.split("/")
+            extra = self.drt.namespace(ns).component(comp).endpoint(name)
+            self.extra_clients.append(await extra.client())
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     def export_stats(self, stats: dict) -> None:
@@ -211,11 +236,18 @@ class MetricsAggregator:
             c.inc(cur if prev is None else max(cur - prev, 0.0))
             self._last[("fleet", key)] = cur
 
+    async def scrape_once(self) -> dict:
+        """One merged scrape across the primary + extra endpoints (worker
+        ids are lease ids, unique across components)."""
+        stats = await self.client.scrape_stats()
+        for client in getattr(self, "extra_clients", ()):
+            stats.update(await client.scrape_stats())
+        return stats
+
     async def _loop(self) -> None:
         try:
             while True:
-                stats = await self.client.scrape_stats()
-                self.export_stats(stats)
+                self.export_stats(await self.scrape_once())
                 await asyncio.sleep(self.interval_s)
         except asyncio.CancelledError:
             pass
@@ -231,9 +263,11 @@ class MetricsAggregator:
 
 async def amain(args) -> None:
     drt = await DistributedRuntime.from_settings()
-    ns, comp, ep = args.endpoint.split("/")
+    primary, *extra = args.endpoint
+    ns, comp, ep = primary.split("/")
     agg = MetricsAggregator(drt, ns, comp, ep, interval_s=args.interval,
-                            incident_dir=args.incident_dir)
+                            incident_dir=args.incident_dir,
+                            extra_endpoints=extra)
     await agg.start()
     health = SystemHealth()
     health.set_system_ready()
@@ -247,7 +281,10 @@ async def amain(args) -> None:
 def main() -> None:
     init_logging()
     p = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
-    p.add_argument("--endpoint", required=True, help="ns/component/endpoint to scrape")
+    p.add_argument("--endpoint", action="append", required=True,
+                   help="ns/component/endpoint to scrape (repeatable: a "
+                        "disagg deployment names its prefill, decode, and "
+                        "planner endpoints)")
     p.add_argument("--port", type=int, default=9090)
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--incident-dir", default=None,

@@ -11,8 +11,22 @@ from dynamo_tpu.planner.load_predictor import (
     ConstantPredictor,
     LoadPredictor,
     SeasonalNaivePredictor,
+    SeasonalTrendPredictor,
+    TrendPredictor,
     make_predictor,
 )
 from dynamo_tpu.planner.interpolator import PrefillInterpolator, DecodeInterpolator
 from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, SlaTargets
 from dynamo_tpu.planner.connectors import LocalConnector, VirtualConnector
+from dynamo_tpu.planner.controller import (
+    AutoscaleController,
+    CapacityModel,
+    ControllerConfig,
+    Decision,
+    FleetView,
+    MockerCapacityModel,
+    StaticCapacityModel,
+    WorkerView,
+    rank_coldest,
+)
+from dynamo_tpu.planner.fleet import AutoscaleLoop, MockerFleet

@@ -1,0 +1,381 @@
+"""Closed-loop SLA autoscaler: the planner's decision layer.
+
+Ref: ROADMAP item 1 and "Taming the Chaos: Coordinated Autoscaling for
+Heterogeneous and Disaggregated LLM Inference" (arXiv 2508.19559) — the
+prefill and decode pools of a disaggregated deployment saturate on
+*different* signals (prefill on input-token rate, decode on output-token
+rate × batch residency), so one shared replica count always over- or
+under-provisions one side. This controller scales the pools independently
+but **coordinately**: both desired sizes derive from one predicted load
+(rate/ISL/OSL from the same observation window), a shared chip budget
+clamps them together preserving their ratio, and the SLA feedback
+corrections read the same fleet-merged quantiles.
+
+Design, per decision interval:
+
+  observe → predict → desire → gate → act
+
+- **desire**: per-pool target from a :class:`CapacityModel` (tokens/s a
+  worker sustains at the predicted ISL/OSL) plus reactive SLA feedback —
+  TTFT/queue-wait pressure bumps prefill, TPOT/KV pressure bumps decode —
+  so the loop stays closed even when the feed-forward model is miscalibrated.
+- **gate** (the anti-flap machinery, in order):
+  *hysteresis* — a pool only moves after the demand signal has agreed for
+  ``scale_up_stable_intervals`` / ``scale_down_stable_intervals``
+  consecutive windows (quantile noise never flips a single window into a
+  fleet change); *cooldown* — after any action a pool holds for
+  ``scale_cooldown_s`` (launch/drain transients would otherwise echo into
+  the next observation and flap); *drain debounce* — a scale-down is never
+  issued while a previous drain is still in flight (DynaServe's "one
+  elastic step at a time": capacity accounting during an unfinished drain
+  is a lie, and stacking drains can hollow a pool).
+- **act**: slice-granular (``max_step`` workers per decision per pool);
+  scale-down names explicit *victims* — the **coldest** workers by the KV
+  warmth signal (the router's actual-reuse accounting from PR 5 merged
+  with the engine-side cached-block fraction and KV utilization), so a
+  shrink erodes the fleet's prefix cache as little as possible.
+
+The controller is a pure decision function over
+``(ObservedLoad, FleetView, now)`` — no I/O, no clocks of its own — so the
+decision table is exactly replayable in tests. Actuation lives in
+:mod:`dynamo_tpu.planner.fleet`; every decision lands in counters/gauges
+(``planner_*`` keys on the stats wire → aggregator → Grafana "Planner"
+row) and as a ``planner_decision`` trace event in the tracer ring.
+"""
+
+from __future__ import annotations
+
+import math
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from dynamo_tpu.planner.load_predictor import LoadPredictor, make_predictor
+from dynamo_tpu.planner.planner_core import ObservedLoad
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_tracer
+
+logger = get_logger(__name__)
+
+PREFILL = "prefill"
+DECODE = "decode"
+POOLS = (PREFILL, DECODE)
+
+
+# --- capacity models ----------------------------------------------------------
+class CapacityModel:
+    """Per-worker sustained throughput as a function of the offered shape.
+
+    The controller inverts this into pool sizes; the ``autoscale`` bench's
+    oracle applies the same inversion to the *true* offered load, so
+    "converged" means the controller recovered the oracle sizes from noisy
+    observed signals alone."""
+
+    utilization: float = 0.8  # headroom target: size pools to this fraction
+
+    def prefill_tokens_per_s(self, isl: float) -> float:
+        raise NotImplementedError
+
+    def decode_tokens_per_s(self, isl: float, osl: float) -> float:
+        raise NotImplementedError
+
+    def required(self, rate: float, isl: float, osl: float) -> Dict[str, int]:
+        """Workers each pool needs for ``rate`` req/s at this shape."""
+        isl = max(isl, 1.0)
+        osl = max(osl, 1.0)
+        rate = max(rate, 0.0)
+        pre = rate * isl / max(self.prefill_tokens_per_s(isl) * self.utilization, 1e-9)
+        dec = rate * osl / max(self.decode_tokens_per_s(isl, osl) * self.utilization, 1e-9)
+        return {PREFILL: max(1, math.ceil(pre)), DECODE: max(1, math.ceil(dec))}
+
+
+class MockerCapacityModel(CapacityModel):
+    """Capacity derived from the mocker's own timing model (llm/mocker.py):
+    the traffic harness and the controller then agree on what a worker can
+    do, and any gap between plan and attainment is *queueing*, not model
+    drift."""
+
+    def __init__(self, args, decode_args=None, utilization: float = 0.8):
+        # Heterogeneous pools: the prefill pool's timing args size prefill
+        # capacity, the decode pool's size decode capacity.
+        self.args = args
+        self.decode_args = decode_args if decode_args is not None else args
+        self.utilization = utilization
+
+    def prefill_tokens_per_s(self, isl: float) -> float:
+        a = self.args
+        chunk = min(max(isl, 1.0), a.max_prefill_chunk)
+        return chunk / (a.prefill_ms(chunk) / 1000.0) * a.speedup_ratio
+
+    def decode_tokens_per_s(self, isl: float, osl: float) -> float:
+        a = self.decode_args
+        b = a.max_batch
+        step_ms = a.decode_ms(b, int(b * (isl + osl)))
+        return b / (step_ms / 1000.0) * a.speedup_ratio
+
+
+class StaticCapacityModel(CapacityModel):
+    """Fixed per-worker token rates (profiled offline, e.g. from the
+    planner interpolators' measured surfaces)."""
+
+    def __init__(self, prefill_tok_s: float, decode_tok_s: float, utilization: float = 0.8):
+        self._pre = prefill_tok_s
+        self._dec = decode_tok_s
+        self.utilization = utilization
+
+    def prefill_tokens_per_s(self, isl: float) -> float:
+        return self._pre
+
+    def decode_tokens_per_s(self, isl: float, osl: float) -> float:
+        return self._dec
+
+
+# --- fleet view (what the controller sees) ------------------------------------
+@dataclass
+class WorkerView:
+    """One worker of one pool, as the decision layer sees it."""
+
+    worker_id: int
+    kv_util: float = 0.0  # allocator usage 0..1 (live load)
+    kv_warmth: float = 0.0  # cached-block fraction 0..1 (reusable prefix KV)
+    cached_tokens_total: int = 0  # router-accounted ACTUAL reuse served here
+    draining: bool = False
+
+    def warmth_score(self, max_cached: int) -> float:
+        """Composite KV warmth: router-proven reuse dominates (a worker the
+        router keeps hitting is the one whose prefixes traffic actually
+        wants), engine-side cached depth and live utilization break ties."""
+        reuse = self.cached_tokens_total / max_cached if max_cached > 0 else 0.0
+        return 2.0 * reuse + 1.0 * self.kv_warmth + 0.5 * self.kv_util
+
+
+@dataclass
+class FleetView:
+    """Point-in-time fleet state handed to ``decide``."""
+
+    pools: Dict[str, List[WorkerView]] = field(default_factory=lambda: {PREFILL: [], DECODE: []})
+    drains_in_flight: Dict[str, int] = field(default_factory=dict)
+
+    def size(self, pool: str) -> int:
+        return len(self.pools.get(pool, ()))
+
+
+def rank_coldest(workers: Sequence[WorkerView], n: int) -> List[int]:
+    """The ``n`` coldest drain candidates by the composite warmth score.
+    Already-draining workers are never candidates (they are leaving)."""
+    live = [w for w in workers if not w.draining]
+    max_cached = max((w.cached_tokens_total for w in live), default=0)
+    ranked = sorted(live, key=lambda w: (w.warmth_score(max_cached), w.worker_id))
+    return [w.worker_id for w in ranked[:n]]
+
+
+# --- decisions ----------------------------------------------------------------
+@dataclass
+class Decision:
+    pool: str
+    action: str  # "add" | "drain" | "hold"
+    count: int  # workers added/drained (0 for hold)
+    target: int  # desired size after gating
+    current: int
+    victims: List[int] = field(default_factory=list)  # drain: coldest-first ids
+    reason: str = ""
+
+
+@dataclass
+class ControllerConfig:
+    min_prefill: int = 1
+    max_prefill: int = 8
+    min_decode: int = 1
+    max_decode: int = 8
+    max_total: int = 0  # shared chip budget; 0 = min/max bounds only
+    scale_cooldown_s: float = 60.0
+    scale_up_stable_intervals: int = 1  # react fast to pressure...
+    scale_down_stable_intervals: int = 3  # ...but shrink only on sustained calm
+    max_step: int = 2  # slice granularity: workers per decision per pool
+    # Reactive SLA feedback (closed loop even under model miscalibration).
+    slo_floor: float = 0.9  # attainment below this bumps the pressured pool
+    ttft_sla_s: float = 0.0  # 0 = judge from slo_attainment + queue signals only
+    tpot_sla_s: float = 0.0
+    kv_pressure: float = 0.9  # mean decode kv_util above this bumps decode
+    load_predictor: str = "trend"
+    dry_run: bool = False  # log + count decisions, actuator skips them
+
+    def bounds(self, pool: str) -> tuple:
+        if pool == PREFILL:
+            return self.min_prefill, self.max_prefill
+        return self.min_decode, self.max_decode
+
+
+class AutoscaleController:
+    """The decision layer. Call :meth:`decide` once per adjustment interval
+    with a fresh ``ObservedLoad`` and ``FleetView``; apply the returned
+    decisions through :class:`dynamo_tpu.planner.fleet.MockerFleet` (or any
+    actuator honoring add/drain + victims)."""
+
+    def __init__(self, config: ControllerConfig, capacity: CapacityModel):
+        self.config = config
+        self.capacity = capacity
+        self.rate_predictor: LoadPredictor = make_predictor(config.load_predictor)
+        self.isl_predictor: LoadPredictor = make_predictor(config.load_predictor)
+        self.osl_predictor: LoadPredictor = make_predictor(config.load_predictor)
+        # Gating state, per pool.
+        self._over: Dict[str, int] = {p: 0 for p in POOLS}
+        self._under: Dict[str, int] = {p: 0 for p in POOLS}
+        self._last_action_ts: Dict[str, float] = {}
+        # Decision counters/gauges (→ to_stats → aggregator → Grafana).
+        self.decisions_total = 0
+        self.scale_up_total = 0
+        self.scale_down_total = 0
+        self.hysteresis_suppressed_total = 0
+        self.cooldown_suppressed_total = 0
+        self.drain_debounced_total = 0
+        self._targets: Dict[str, int] = {PREFILL: 0, DECODE: 0}
+        self._trace_id = uuid.uuid4().hex
+
+    # --- desire ------------------------------------------------------------
+    def desired_sizes(self, load: ObservedLoad) -> Dict[str, int]:
+        """Feed-forward capacity inversion + reactive SLA feedback, clamped
+        to bounds and the shared budget."""
+        c = self.config
+        want = self.capacity.required(load.request_rate, load.avg_isl, load.avg_osl)
+
+        # Closed-loop corrections: attribute an SLO breach to the pool whose
+        # signal is pressured. Queue-wait/TTFT pressure is prefill-side
+        # (admission starved), TPOT/KV pressure is decode-side (batch too
+        # deep or pool too hot). Only bump on real traffic — an idle fleet
+        # reports attainment 1.0 and zero quantiles.
+        breach = load.slo_attainment < c.slo_floor
+        ttft_hot = c.ttft_sla_s > 0 and load.ttft_p99 > c.ttft_sla_s
+        tpot_hot = c.tpot_sla_s > 0 and load.tpot_p99 > c.tpot_sla_s
+        if (breach or ttft_hot) and load.request_rate > 0 and (
+            ttft_hot or load.queue_wait_p99 >= load.tpot_p99
+        ):
+            want[PREFILL] += 1
+        if (breach and tpot_hot) or (tpot_hot and load.request_rate > 0):
+            want[DECODE] += 1
+        if load.kv_util > c.kv_pressure:
+            want[DECODE] += 1
+
+        for pool in POOLS:
+            lo, hi = c.bounds(pool)
+            want[pool] = max(lo, min(hi, want[pool]))
+        # Coordinated budget clamp, preserving the prefill:decode ratio
+        # (ref planner_core.compute_replicas :339-352).
+        if c.max_total and want[PREFILL] + want[DECODE] > c.max_total:
+            scale = c.max_total / (want[PREFILL] + want[DECODE])
+            for pool in POOLS:
+                lo, _ = c.bounds(pool)
+                want[pool] = max(lo, math.floor(want[pool] * scale))
+        return want
+
+    # --- the decision function --------------------------------------------
+    def decide(self, load: ObservedLoad, view: FleetView, now: float) -> List[Decision]:
+        c = self.config
+        self.decisions_total += 1
+        self.rate_predictor.observe(load.request_rate)
+        self.isl_predictor.observe(load.avg_isl)
+        self.osl_predictor.observe(load.avg_osl)
+        predicted = ObservedLoad(
+            request_rate=self.rate_predictor.predict(),
+            avg_isl=self.isl_predictor.predict(),
+            avg_osl=self.osl_predictor.predict(),
+            ttft_p99=load.ttft_p99,
+            tpot_p99=load.tpot_p99,
+            queue_wait_p99=load.queue_wait_p99,
+            slo_attainment=load.slo_attainment,
+            kv_util=load.kv_util,
+        )
+        want = self.desired_sizes(predicted)
+        self._targets = dict(want)
+
+        out: List[Decision] = []
+        for pool in POOLS:
+            current = view.size(pool)
+            target = want[pool]
+            decision = self._gate(pool, current, target, view, now)
+            out.append(decision)
+            self._trace(decision, predicted)
+            if decision.action != "hold":
+                logger.info(
+                    "planner %s: %s %d -> %d (%s)%s",
+                    pool, decision.action, current, decision.target, decision.reason,
+                    " [dry-run]" if c.dry_run else "",
+                )
+        return out
+
+    def _gate(self, pool: str, current: int, target: int, view: FleetView, now: float) -> Decision:
+        c = self.config
+        hold = Decision(pool, "hold", 0, current, current)
+
+        # Hysteresis bookkeeping: consecutive windows of agreement.
+        if target > current:
+            self._over[pool] += 1
+            self._under[pool] = 0
+        elif target < current:
+            self._under[pool] += 1
+            self._over[pool] = 0
+        else:
+            self._over[pool] = self._under[pool] = 0
+            return hold
+
+        up = target > current
+        needed = c.scale_up_stable_intervals if up else c.scale_down_stable_intervals
+        streak = self._over[pool] if up else self._under[pool]
+        if streak < needed:
+            self.hysteresis_suppressed_total += 1
+            hold.reason = f"hysteresis {streak}/{needed}"
+            return hold
+
+        last = self._last_action_ts.get(pool)
+        if last is not None and now - last < c.scale_cooldown_s:
+            self.cooldown_suppressed_total += 1
+            hold.reason = f"cooldown {now - last:.1f}s/{c.scale_cooldown_s:.0f}s"
+            return hold
+
+        if not up and view.drains_in_flight.get(pool, 0) > 0:
+            # Debounce: the previous drain has not landed; the pool's true
+            # capacity is already below ``current`` and shrinking again
+            # would double-count the same decision.
+            self.drain_debounced_total += 1
+            hold.reason = f"drain in flight ({view.drains_in_flight[pool]})"
+            return hold
+
+        count = min(abs(target - current), c.max_step)
+        stepped = current + count if up else current - count
+        self._last_action_ts[pool] = now
+        self._over[pool] = self._under[pool] = 0
+        if up:
+            self.scale_up_total += 1
+            return Decision(pool, "add", count, stepped, current,
+                            reason=f"demand {target} > {current}")
+        self.scale_down_total += 1
+        victims = rank_coldest(view.pools.get(pool, ()), count)
+        return Decision(pool, "drain", len(victims), stepped, current, victims=victims,
+                        reason=f"demand {target} < {current}, coldest={['%x' % v for v in victims]}")
+
+    # --- observability -----------------------------------------------------
+    def _trace(self, d: Decision, predicted: ObservedLoad) -> None:
+        get_tracer().event(
+            "planner_decision", self._trace_id, service="planner",
+            pool=d.pool, action=d.action, count=d.count, target=d.target,
+            current=d.current, victims=[f"{v:x}" for v in d.victims],
+            reason=d.reason, rate=round(predicted.request_rate, 3),
+            isl=round(predicted.avg_isl, 1), osl=round(predicted.avg_osl, 1),
+            dry_run=self.config.dry_run,
+        )
+
+    def to_stats(self) -> dict:
+        """Planner decision counters/gauges on the stats-scrape wire (same
+        shape the aggregator's COUNTER_KEYS/GAUGE_KEYS registries expect;
+        the fleet serves this on a scraped ``planner`` endpoint)."""
+        return {
+            "planner_decisions_total": self.decisions_total,
+            "planner_scale_up_total": self.scale_up_total,
+            "planner_scale_down_total": self.scale_down_total,
+            "planner_hysteresis_suppressed_total": self.hysteresis_suppressed_total,
+            "planner_cooldown_suppressed_total": self.cooldown_suppressed_total,
+            "planner_drain_debounced_total": self.drain_debounced_total,
+            "planner_prefill_target": float(self._targets.get(PREFILL, 0)),
+            "planner_decode_target": float(self._targets.get(DECODE, 0)),
+            "planner_dry_run": 1.0 if self.config.dry_run else 0.0,
+        }

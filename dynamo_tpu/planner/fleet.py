@@ -1,0 +1,293 @@
+"""Fleet actuation for the autoscale controller: real in-process workers.
+
+The decision layer (:mod:`dynamo_tpu.planner.controller`) is pure; this
+module makes its decisions *real capacity changes*: ``add`` launches a
+mocker worker — served endpoint, KV-event + metrics publishers, forced
+wire path — and ``drain`` retires one through the PR 10 drain lifecycle
+(deregister → reject-new-to-migration → finish/sever in-flight → revoke),
+so routers, the aggregator, and live requests observe exactly what a
+production scale event looks like, process-free.
+
+Drains run as background tasks and are *tracked*: ``drains_in_flight`` is
+the controller's debounce signal (never a second scale-down while one is
+still landing). The planner itself is scrape-observable — ``serve_planner``
+registers a ``planner`` endpoint whose stats handler is the controller's
+counter/gauge dict, so the metrics aggregator exports planner decisions
+next to worker stats and the Grafana "Planner" row stays MET001-pinned.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from dynamo_tpu.planner.controller import (
+    DECODE,
+    POOLS,
+    PREFILL,
+    AutoscaleController,
+    Decision,
+    FleetView,
+    WorkerView,
+    rank_coldest,
+)
+from dynamo_tpu.planner.planner_core import ObservedLoad
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class FleetWorker:
+    component: str
+    worker_id: int
+    engine: object
+    handle: object
+    publishers: List[object] = field(default_factory=list)
+
+
+class MockerFleet:
+    """Launch/drain in-process mocker workers per pool (prefill/decode).
+
+    ``make_args(component) -> MockEngineArgs`` parameterizes each pool's
+    engine (heterogeneous pools: prefill-tuned vs decode-tuned timing).
+    """
+
+    def __init__(
+        self,
+        drt,
+        namespace: str = "autoscale",
+        *,
+        make_args: Optional[Callable[[str], object]] = None,
+        endpoint_name: str = "generate",
+        drain_timeout_s: float = 10.0,
+        publish_kv_events: bool = True,
+        wire_path: bool = True,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.endpoint_name = endpoint_name
+        self.drain_timeout_s = drain_timeout_s
+        self.publish_kv_events = publish_kv_events
+        self.wire_path = wire_path
+        self.make_args = make_args or (lambda component: None)
+        self.pools: Dict[str, List[FleetWorker]] = {p: [] for p in POOLS}
+        self._drains: Dict[str, set] = {p: set() for p in POOLS}
+        self.launches_total = 0
+        self.drains_total = 0
+        self._planner_handle = None
+
+    def endpoint(self, component: str):
+        return self.drt.namespace(self.namespace).component(component).endpoint(self.endpoint_name)
+
+    def scrape_endpoints(self) -> List[str]:
+        """``ns/component/endpoint`` strings the metrics aggregator should
+        scrape to see the whole autoscaling plane (both pools + planner)."""
+        eps = [f"{self.namespace}/{c}/{self.endpoint_name}" for c in POOLS]
+        if self._planner_handle is not None:
+            eps.append(f"{self.namespace}/planner/control")
+        return eps
+
+    # --- launch -------------------------------------------------------------
+    async def add_worker(self, component: str) -> FleetWorker:
+        from dynamo_tpu.llm.kv_router import KvEventPublisher, WorkerMetricsPublisher
+        from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+
+        args = self.make_args(component) or MockEngineArgs()
+        engine = MockTpuEngine(args)
+        ep = self.endpoint(component)
+        handle = await ep.serve_endpoint(engine.generate, stats_handler=engine.stats_handler)
+        worker_id = handle.instance.instance_id
+        publishers: List[object] = []
+        if self.publish_kv_events:
+            kv_pub = KvEventPublisher(self.drt, ep.namespace, ep.component, worker_id)
+            kv_pub.start()
+            engine.set_kv_event_sink(kv_pub.publish)
+            m_pub = WorkerMetricsPublisher(
+                self.drt, ep.namespace, ep.component, worker_id, engine.metrics, interval_s=0.25
+            )
+            m_pub.start()
+            publishers = [kv_pub, m_pub]
+        if self.wire_path:
+            # Real deployments cross the pub/sub + TCP wire; the local
+            # fast path would hide drain/migration semantics.
+            self.drt.local_engines.pop(worker_id, None)
+        worker = FleetWorker(component, worker_id, engine, handle, publishers)
+        self.pools[component].append(worker)
+        self.launches_total += 1
+        logger.info("fleet: launched %s worker %x (pool=%d)",
+                    component, worker_id, len(self.pools[component]))
+        return worker
+
+    # --- drain --------------------------------------------------------------
+    def drain_worker(self, component: str, worker_id: int) -> Optional[asyncio.Task]:
+        """Start a tracked background drain of one worker; returns the task
+        (None if the id is not live in the pool)."""
+        pool = self.pools[component]
+        worker = next((w for w in pool if w.worker_id == worker_id), None)
+        if worker is None:
+            return None
+        # Out of the pool immediately: capacity accounting must not count a
+        # leaving worker, and the controller's view stops offering it as a
+        # victim. The drain itself completes in the background.
+        pool.remove(worker)
+
+        async def _drain() -> None:
+            try:
+                await worker.handle.stop(drain=True, timeout_s=self.drain_timeout_s)
+            finally:
+                for pub in worker.publishers:
+                    try:
+                        await pub.stop()
+                    except Exception:  # noqa: BLE001 — cleanup must not leak a drain slot
+                        logger.exception("fleet: publisher stop failed for %x", worker_id)
+                self.drains_total += 1
+                logger.info("fleet: drained %s worker %x (pool=%d)",
+                            component, worker_id, len(pool))
+
+        task = asyncio.get_running_loop().create_task(_drain())
+        self._drains[component].add(task)
+        task.add_done_callback(self._drains[component].discard)
+        return task
+
+    def drains_in_flight(self, component: str) -> int:
+        return sum(1 for t in self._drains[component] if not t.done())
+
+    async def wait_drains(self, timeout: float = 30.0) -> bool:
+        pending = [t for drains in self._drains.values() for t in drains]
+        if not pending:
+            return True
+        done, not_done = await asyncio.wait(pending, timeout=timeout)
+        return not not_done
+
+    # --- view ---------------------------------------------------------------
+    def view(self, router_stats: Optional[dict] = None) -> FleetView:
+        """The controller's input: live pool membership + per-worker KV
+        warmth. ``router_stats`` is ``KvPushRouter.stats()`` — its
+        ``cached_tokens_by_worker`` (ACTUAL engine-reported reuse per
+        worker, PR 5) is the strongest warmth signal."""
+        by_worker = (router_stats or {}).get("cached_tokens_by_worker", {})
+        pools: Dict[str, List[WorkerView]] = {}
+        for component, workers in self.pools.items():
+            views = []
+            for w in workers:
+                alloc = w.engine.allocator
+                views.append(WorkerView(
+                    worker_id=w.worker_id,
+                    kv_util=alloc.usage(),
+                    kv_warmth=alloc.num_cached / alloc.num_blocks if alloc.num_blocks else 0.0,
+                    cached_tokens_total=int(by_worker.get(w.worker_id, 0)),
+                    draining=bool(getattr(w.handle, "draining", False)),
+                ))
+            pools[component] = views
+        return FleetView(
+            pools=pools,
+            drains_in_flight={c: self.drains_in_flight(c) for c in POOLS},
+        )
+
+    def size(self, component: str) -> int:
+        return len(self.pools[component])
+
+    # --- actuation ----------------------------------------------------------
+    async def apply(self, decisions: List[Decision]) -> None:
+        for d in decisions:
+            if d.action == "add":
+                for _ in range(d.count):
+                    await self.add_worker(d.pool)
+            elif d.action == "drain":
+                victims = list(d.victims)
+                if not victims and d.count:
+                    victims = rank_coldest(self.view().pools.get(d.pool, ()), d.count)
+                for v in victims:
+                    self.drain_worker(d.pool, v)
+
+    # --- planner observability ----------------------------------------------
+    async def serve_planner(self, controller: AutoscaleController):
+        """Expose the controller's decision counters on the stats-scrape
+        wire: a ``planner`` pseudo-worker whose scrape dict is
+        ``controller.to_stats()`` (aggregator → ``planner_*`` families)."""
+
+        async def _control(request, context):
+            yield {"planner": True, **controller.to_stats()}
+
+        ep = self.drt.namespace(self.namespace).component("planner").endpoint("control")
+        self._planner_handle = await ep.serve_endpoint(_control, stats_handler=controller.to_stats)
+        return self._planner_handle
+
+    async def shutdown(self) -> None:
+        await self.wait_drains(timeout=self.drain_timeout_s + 5.0)
+        for component in list(self.pools):
+            for worker in list(self.pools[component]):
+                self.drain_worker(component, worker.worker_id)
+        await self.wait_drains(timeout=self.drain_timeout_s + 5.0)
+        if self._planner_handle is not None:
+            await self._planner_handle.stop(drain=False)
+            self._planner_handle = None
+
+    def summary(self) -> dict:
+        return {
+            "launches": self.launches_total,
+            "drains": self.drains_total,
+            "pools": {c: [f"{w.worker_id:x}" for w in ws] for c, ws in self.pools.items()},
+        }
+
+
+class AutoscaleLoop:
+    """observe → decide → act on a fixed adjustment interval.
+
+    ``observe_fn`` yields :class:`ObservedLoad` (typically
+    ``PrometheusObserver.observe`` over the aggregator's /metrics);
+    ``router_stats_fn`` feeds the warmth ranking. ``step()`` is public so
+    harnesses can drive compressed time deterministically."""
+
+    def __init__(
+        self,
+        controller: AutoscaleController,
+        fleet: MockerFleet,
+        observe_fn: Callable[[], Awaitable[ObservedLoad]],
+        *,
+        interval_s: float = 10.0,
+        router_stats_fn: Optional[Callable[[], dict]] = None,
+    ):
+        self.controller = controller
+        self.fleet = fleet
+        self.observe_fn = observe_fn
+        self.interval_s = interval_s
+        self.router_stats_fn = router_stats_fn
+        self.decision_log: List[Decision] = []
+        self._task: Optional[asyncio.Task] = None
+
+    async def step(self, now: Optional[float] = None) -> List[Decision]:
+        load = await self.observe_fn()
+        router_stats = self.router_stats_fn() if self.router_stats_fn else None
+        view = self.fleet.view(router_stats)
+        decisions = self.controller.decide(
+            load, view, time.monotonic() if now is None else now
+        )
+        self.decision_log.extend(d for d in decisions if d.action != "hold")
+        if not self.controller.config.dry_run:
+            await self.fleet.apply(decisions)
+        return decisions
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("autoscale step failed")
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

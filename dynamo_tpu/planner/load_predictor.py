@@ -69,12 +69,68 @@ class SeasonalNaivePredictor(LoadPredictor):
         return self.history[-1] if self.history else 0.0
 
 
+class TrendPredictor(LoadPredictor):
+    """Trailing-window linear trend, extrapolated one interval ahead.
+
+    Fixes the constant predictor's structural ramp bias: "next = last
+    observed" is exactly one adjustment interval behind any monotone ramp,
+    so a planner steering on it scales for the load of the *previous*
+    window, permanently. A least-squares slope over the trailing window
+    projects ``last + slope`` instead — zero-lag on a linear ramp, and the
+    window averaging keeps single-sample noise from whipping the estimate
+    (validated against the traffic harness's diurnal ramp in
+    tests/test_autoscale.py)."""
+
+    def __init__(self, window: int = 8):
+        super().__init__(window)
+
+    def predict(self) -> float:
+        h = np.asarray(self.history, dtype=np.float64)
+        if len(h) == 0:
+            return 0.0
+        if len(h) < 3:
+            return float(h[-1])
+        x = np.arange(len(h), dtype=np.float64)
+        slope, intercept = np.polyfit(x, h, 1)
+        return max(0.0, float(slope * len(h) + intercept))
+
+
+class SeasonalTrendPredictor(LoadPredictor):
+    """Seasonality-aware mode (ARIMA-lite): seasonal-naive base plus the
+    trailing linear trend of the seasonal residual. Tracks a diurnal sine
+    through its turning points — where a pure trend overshoots the crest
+    and the seasonal-naive alone lags by however much the day has grown."""
+
+    def __init__(self, window: int = 256, period: int = 24, trend_window: int = 8):
+        super().__init__(window)
+        self.period = period
+        self._trend = TrendPredictor(window=trend_window)
+
+    def observe(self, value: float) -> None:
+        super().observe(value)
+        if len(self.history) > self.period:
+            # Residual vs one period ago: how much this cycle differs from
+            # the last (the day-over-day growth the naive term misses).
+            self._trend.observe(value - self.history[-1 - self.period])
+
+    def predict(self) -> float:
+        if len(self.history) <= self.period:
+            # No full period yet: fall back to trend-on-levels.
+            t = TrendPredictor(window=min(8, max(3, len(self.history))))
+            for v in self.history:
+                t.observe(v)
+            return t.predict()
+        return max(0.0, self.history[-self.period] + self._trend.predict())
+
+
 def make_predictor(kind: str, **kwargs) -> LoadPredictor:
     kinds = {
         "constant": ConstantPredictor,
         "arima": ARIMAPredictor,
+        "trend": TrendPredictor,
         "seasonal": SeasonalNaivePredictor,
-        "prophet": SeasonalNaivePredictor,  # alias: closest available model
+        "seasonal_trend": SeasonalTrendPredictor,
+        "prophet": SeasonalTrendPredictor,  # alias: closest available model
     }
     if kind not in kinds:
         raise ValueError(f"unknown predictor {kind!r} (have {sorted(kinds)})")

@@ -21,11 +21,28 @@ def main() -> None:
     p.add_argument("--frontend-metrics-url", default="http://127.0.0.1:8000/metrics")
     p.add_argument("--prefill-profile", required=True, help="npz from dynamo_tpu.planner.profiler")
     p.add_argument("--decode-profile", required=True)
-    p.add_argument("--adjustment-interval", type=float, default=30.0)
+    p.add_argument("--adjustment-interval", type=float, default=30.0,
+                   help="seconds between observe→predict→decide→act passes")
     p.add_argument("--ttft-sla-ms", type=float, default=200.0)
     p.add_argument("--itl-sla-ms", type=float, default=20.0)
     p.add_argument("--max-chip-budget", type=int, default=8)
-    p.add_argument("--load-predictor", choices=["constant", "arima", "seasonal", "prophet"], default="arima")
+    p.add_argument("--min-prefill", type=int, default=1,
+                   help="prefill pool floor (replicas)")
+    p.add_argument("--max-prefill", type=int, default=0,
+                   help="prefill pool ceiling (0 = chip budget only)")
+    p.add_argument("--min-decode", type=int, default=1,
+                   help="decode pool floor (replicas)")
+    p.add_argument("--max-decode", type=int, default=0,
+                   help="decode pool ceiling (0 = chip budget only)")
+    p.add_argument("--scale-cooldown-s", type=float, default=0.0,
+                   help="hold this long after any applied scale change "
+                        "(suppresses flapping on launch/drain transients)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="log scaling decisions without driving the connector")
+    p.add_argument("--load-predictor",
+                   choices=["constant", "arima", "trend", "seasonal",
+                            "seasonal_trend", "prophet"],
+                   default="arima")
     p.add_argument("--connector", choices=["virtual", "kubernetes"], default="virtual")
     p.add_argument("--k8s-namespace", default="default")
     args = p.parse_args()
@@ -34,6 +51,12 @@ def main() -> None:
         adjustment_interval_s=args.adjustment_interval,
         load_predictor=args.load_predictor,
         max_chip_budget=args.max_chip_budget,
+        min_prefill_replicas=args.min_prefill,
+        max_prefill_replicas=args.max_prefill,
+        min_decode_replicas=args.min_decode,
+        max_decode_replicas=args.max_decode,
+        scale_cooldown_s=args.scale_cooldown_s,
+        dry_run=args.dry_run,
         sla=SlaTargets(ttft_ms=args.ttft_sla_ms, itl_ms=args.itl_sla_ms),
     )
     connector = (

@@ -161,6 +161,14 @@ class PrometheusObserver:
             d_req = delta("dynamo_frontend_requests_total")
             d_in = delta("dynamo_frontend_input_tokens_total")
             d_out = delta("dynamo_frontend_output_tokens_total")
+            if d_req == 0.0:
+                # Frontend-less stacks (mocker fleets under the traffic
+                # harness, engine-only deployments): derive the traffic
+                # shape from the engine-side counters the aggregator
+                # forwards (worker_request_total / worker_*_tokens_total).
+                d_req = delta_suffix("worker_request_total")
+                d_in = delta_suffix("worker_input_tokens_total")
+                d_out = delta_suffix("worker_output_tokens_total")
             # SLO attainment over THIS window (counter deltas, all sources:
             # frontend phase-labeled + worker flat keys both end in
             # slo_*attained_total / slo_*violated_total).

@@ -77,7 +77,16 @@ class PlannerConfig:
     chips_per_decode_engine: int = 1
     min_prefill_replicas: int = 1
     min_decode_replicas: int = 1
+    # Per-pool ceilings (0 = bounded by max_chip_budget only).
+    max_prefill_replicas: int = 0
+    max_decode_replicas: int = 0
     max_chip_budget: int = 8
+    # Hold after any applied change (0 = act every interval). Launch/drain
+    # transients echo into the next observation window; acting on that echo
+    # flaps the fleet.
+    scale_cooldown_s: float = 0.0
+    # Log decisions without driving the connector.
+    dry_run: bool = False
     sla: SlaTargets = field(default_factory=SlaTargets)
 
 
@@ -106,6 +115,9 @@ class Planner:
         self.osl_predictor: LoadPredictor = make_predictor("constant")
         self._task: Optional[asyncio.Task] = None
         self.last_plan: Optional[ReplicaPlan] = None
+        self._last_change_ts: Optional[float] = None
+        self.cooldown_holds_total = 0
+        self.dry_run_decisions_total = 0
 
     # --- the math (ref: _compute_replica_requirements :259) -----------------
     def compute_replicas(self, load: ObservedLoad) -> ReplicaPlan:
@@ -124,7 +136,12 @@ class Planner:
         decode_chips = rate * osl / max(decode_thpt, 1e-9)
         decode = max(c.min_decode_replicas, math.ceil(decode_chips / c.chips_per_decode_engine))
 
-        # Budget clamp, preserving the prefill:decode ratio (ref :339-352).
+        # Per-pool ceilings, then the budget clamp preserving the
+        # prefill:decode ratio (ref :339-352).
+        if c.max_prefill_replicas > 0:
+            prefill = min(prefill, c.max_prefill_replicas)
+        if c.max_decode_replicas > 0:
+            decode = min(decode, c.max_decode_replicas)
         total_chips = prefill * c.chips_per_prefill_engine + decode * c.chips_per_decode_engine
         if total_chips > c.max_chip_budget:
             scale = c.max_chip_budget / total_chips
@@ -145,16 +162,32 @@ class Planner:
         )
         plan = self.compute_replicas(predicted)
         if self.last_plan is None or plan != self.last_plan:
+            now = time.monotonic()
+            if (
+                self.last_plan is not None
+                and self.config.scale_cooldown_s > 0
+                and self._last_change_ts is not None
+                and now - self._last_change_ts < self.config.scale_cooldown_s
+            ):
+                # Cooldown: hold the applied plan; the demand re-evaluates
+                # next interval with the transient settled.
+                self.cooldown_holds_total += 1
+                return self.last_plan
             logger.info(
-                "planner: rate=%.2f isl=%.0f osl=%.0f ttft_p99=%.3fs tpot_p99=%.4fs "
+                "planner%s: rate=%.2f isl=%.0f osl=%.0f ttft_p99=%.3fs tpot_p99=%.4fs "
                 "slo=%.2f goodput=%.2freq/s kv=%.2f -> prefill=%d decode=%d",
+                " [dry-run]" if self.config.dry_run else "",
                 predicted.request_rate, predicted.avg_isl, predicted.avg_osl,
                 load.ttft_p99, load.tpot_p99, load.slo_attainment,
                 load.goodput_req_s, load.kv_util, plan.prefill, plan.decode,
             )
+            if self.config.dry_run:
+                self.dry_run_decisions_total += 1
+                return plan
             await self.connector.set_replicas(PREFILL_COMPONENT, plan.prefill)
             await self.connector.set_replicas(DECODE_COMPONENT, plan.decode)
             self.last_plan = plan
+            self._last_change_ts = now
         return plan
 
     async def run(self) -> None:

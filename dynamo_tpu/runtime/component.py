@@ -132,7 +132,7 @@ class ServeHandle:
     def draining(self) -> bool:
         return self._ingress.draining if self._ingress is not None else False
 
-    async def stop(self, *, drain: bool = True) -> None:
+    async def stop(self, *, drain: bool = True, timeout_s: Optional[float] = None) -> None:
         """The drain lifecycle (planner scale-down's primitive, and the
         SIGTERM / POST /drain path):
 
@@ -141,32 +141,45 @@ class ServeHandle:
         2. stop admitting — requests already queued on the pub/sub subject
            are answered with a disconnect error, which the client's
            Migration operator replays on a surviving worker;
-        3. finish in-flight work within ``shutdown_timeout_s`` — on
-           timeout the remaining streams are severed (task cancel drops
-           the call-home sockets without a final frame), which *migrates*
-           them instead of finishing them;
+        3. finish in-flight work within ``timeout_s`` (default
+           ``shutdown_timeout_s``) — on timeout the remaining streams are
+           severed (task cancel drops the call-home sockets without a
+           final frame), which *migrates* them instead of finishing them;
         4. revoke the lease.
+
+        The wait is scoped to THIS instance's in-flight requests: in a
+        multi-worker process (autoscaled mocker fleets, demo stacks) the
+        runtime-global shutdown tracker never reaches zero under sustained
+        fleet traffic, which turned every one-worker scale-down drain into
+        a guaranteed full-timeout stall.
         """
         if self._stopped:
             return
         self._stopped = True
         drt = self.endpoint.drt
+        timeout = (
+            timeout_s if timeout_s is not None
+            else drt.runtime.config.runtime.shutdown_timeout_s
+        )
         # Deregister first so routers stop sending, then drain, then drop tasks.
         await drt.store.delete(self.instance.etcd_key)
         drt.local_engines.pop(self.instance.instance_id, None)
         if drain:
             if self._ingress is not None:
                 self._ingress.begin_drain()
-            drained = await drt.runtime.shutdown_tracker.wait_drained(
-                drt.runtime.config.runtime.shutdown_timeout_s
-            )
+                drained = await self._ingress.wait_drained(timeout)
+            else:
+                drained = await drt.runtime.shutdown_tracker.wait_drained(timeout)
             if not drained:
                 logger.warning(
                     "drain of %x timed out with %d in-flight; severing streams "
                     "(clients will migrate)",
                     self.instance.instance_id,
-                    drt.runtime.shutdown_tracker.in_flight,
+                    len(self._ingress.in_flight) if self._ingress is not None
+                    else drt.runtime.shutdown_tracker.in_flight,
                 )
+                if self._ingress is not None:
+                    await self._ingress.sever()
             if self._ingress is not None:
                 self._ingress.finish_drain()
         for t in self._tasks:
@@ -269,6 +282,34 @@ class _PushEndpoint:
 
     def finish_drain(self) -> None:
         self.drains_total += 1
+
+    async def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Wait for THIS instance's in-flight handlers to finish. Scoped to
+        the instance (not the runtime-global shutdown tracker) so a
+        one-worker drain in a multi-worker process completes as soon as
+        *its* streams end, however busy the rest of the fleet is."""
+        deadline = None if timeout is None else asyncio.get_running_loop().time() + timeout
+        while self._request_tasks:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    return False
+            _, pending = await asyncio.wait(set(self._request_tasks), timeout=remaining)
+            if pending:
+                return False
+        return True
+
+    async def sever(self) -> None:
+        """Cancel the remaining in-flight handler tasks: each drops its
+        call-home socket without a final frame, so the client observes a
+        genuine StreamDisconnect and its Migration operator replays the
+        request on a surviving worker."""
+        tasks = list(self._request_tasks)
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
 
     async def start(self, stats_handler=None) -> list:
         sub = await self.drt.bus.subscribe(self.instance.subject)
