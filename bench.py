@@ -800,9 +800,37 @@ def bench_decode_overlap():
         f"{len(declared)} declared in sync_allowlist.json"
     )
 
+    # Static/dynamic cross-validation of the warmup key space: every
+    # executable kind the flight recorder observed compiling during this
+    # section must be statically enumerable by dtlint's WARM001 scan, at a
+    # statically registered arity. If a new record_exec site appears
+    # without a warmup twin, WARM001 fails statically; if the static
+    # enumeration drifts from what actually dispatches, this check fails
+    # dynamically — the two views of the 0-compile invariant cannot
+    # diverge silently.
+    from tools.dtlint.rules_warmup import static_warmup_report
+
+    static = static_warmup_report(
+        _os.path.dirname(_os.path.abspath(__file__)))
+    dynamic_keys = sched.flight.exec_key_summary()
+    for kind, arities in dynamic_keys.items():
+        assert kind in static["warmed"], (
+            f"recorder compiled kind '{kind}' that WARM001's static warmup "
+            f"enumeration does not register"
+        )
+        static_ar = set(static["warmed"][kind])
+        assert not static_ar or set(arities) <= static_ar, (
+            f"kind '{kind}' compiled at arities {arities} but warmup "
+            f"statically registers {sorted(static_ar)}"
+        )
+
     return {
         "points": points,
         "out_tokens": out_tokens,
+        # The warmup key space, both views.
+        "static_warmed_kinds": sorted(static["warmed"]),
+        "dynamic_exec_kinds": sorted(dynamic_keys),
+        "static_dynamic_warmup_views_agree": True,
         # The 1-sync/step invariant, both views.
         "sync_allowlist_per_step_overlap": len(declared),
         "measured_blocking_syncs_per_step": round(measured_per_step, 3),
@@ -1115,6 +1143,26 @@ def bench_observability_overhead():
         "scheduler stats paths fell out of the SYNC001 hot-path scope"
     )
 
+    # Static cross-check with dtlint WARM001: the executable keys that
+    # compiled during this section (all pre-mark_warmup_done, per the
+    # 0-compile assert above) must be inside the statically enumerated
+    # warmup key space — the recorder's dynamic view and the linter's
+    # static view of "what warmup must cover" stay pinned to each other.
+    from tools.dtlint.rules_warmup import static_warmup_report
+
+    _static = static_warmup_report(os.path.dirname(os.path.abspath(__file__)))
+    _dynamic = sched.flight.exec_key_summary()
+    for _kind, _arities in _dynamic.items():
+        assert _kind in _static["warmed"], (
+            f"recorder compiled kind '{_kind}' missing from WARM001's "
+            f"static warmup enumeration"
+        )
+        _sar = set(_static["warmed"][_kind])
+        assert not _sar or set(_arities) <= _sar, (
+            f"kind '{_kind}' compiled at arities {_arities}; static warmup "
+            f"registers {sorted(_sar)}"
+        )
+
     return {
         "tracing_off": off,
         "tracing_on": on,
@@ -1128,6 +1176,11 @@ def bench_observability_overhead():
         "slo_judged_requests": slo_judged,
         "compiles_after_warmup": compiles_after_warmup,
         "stats_path_allowed_syncs": 0,
+        "warmup_views": {
+            "static_warmed_kinds": sorted(_static["warmed"]),
+            "dynamic_exec_kinds": sorted(_dynamic),
+            "agree": True,
+        },
         # Chaos plane armed for the whole measured section with a
         # never-matching scenario: the armed-path site cost rides inside
         # the same ≤2% budget, and zero injections fired (asserted).

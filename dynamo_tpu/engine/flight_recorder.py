@@ -379,6 +379,16 @@ class FlightRecorder:
         self._warmup_done = True
         self._warmed = warmed
 
+    def exec_key_summary(self) -> Dict[str, List[int]]:
+        """{kind: sorted key arities} of every executable key registered so
+        far — the dynamic twin of dtlint's ``static_warmup_report()``.
+        bench.py diffs the two so the static warmup enumeration and the
+        recorder's observed compile keys cannot drift apart."""
+        out: Dict[str, Set[int]] = {}
+        for k in self._exec_keys:
+            out.setdefault(k[0], set()).add(len(k) - 1)
+        return {kind: sorted(v) for kind, v in sorted(out.items())}
+
     # --- export -------------------------------------------------------------
     def to_stats(self) -> dict:
         """Flat dict merged into the worker stats scrape (monotonic keys end

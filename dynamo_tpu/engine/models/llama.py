@@ -342,23 +342,37 @@ def resolve_attention_impl(c: ModelConfig, k_cache) -> str:
       the former hard ValueError — the megakernel is the int8-capable
       fused path.
     """
-    global _warned_paged_int8
     impl = c.attention_impl
     if impl == "auto":
         impl = "megakernel" if _on_tpu() else "gather"
     if impl == "paged" and isinstance(k_cache, QuantKv):
-        if not _warned_paged_int8:
-            _warned_paged_int8 = True
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "attention_impl='paged' has no int8-KV path — degrading to "
-                "the XLA gather for this deployment. Use "
-                "attention_impl='megakernel' for the fused int8 "
-                "dequant-in-VMEM path."
-            )
+        # Pure resolution only: this runs inside traced bodies
+        # (_use_paged_decode / _use_megakernel), where host-side logging is
+        # a trace-time effect. warn_attention_impl_degrade() carries the
+        # operator-facing warning from the scheduler's init path.
         impl = "gather"
     return impl
+
+
+def warn_attention_impl_degrade(c: ModelConfig, k_cache) -> None:
+    """Host-side companion to ``resolve_attention_impl``: log the paged+int8
+    degrade once, from setup code (the scheduler's __init__), never from a
+    jit-reachable body."""
+    global _warned_paged_int8
+    if (
+        c.attention_impl == "paged"
+        and isinstance(k_cache, QuantKv)
+        and not _warned_paged_int8
+    ):
+        _warned_paged_int8 = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "attention_impl='paged' has no int8-KV path — degrading to "
+            "the XLA gather for this deployment. Use "
+            "attention_impl='megakernel' for the fused int8 "
+            "dequant-in-VMEM path."
+        )
 
 
 def _use_paged_decode(c: ModelConfig, k_cache) -> bool:

@@ -422,6 +422,7 @@ class Scheduler:
         # can't reflect the megakernel's actual roofline position.
         self._attn_impl = "gather"
         if model_config.architecture == "llama":
+            llama.warn_attention_impl_degrade(model_config, self.cache.k)
             self._attn_impl = llama.resolve_attention_impl(model_config, self.cache.k)
         kv_read_factor = 1.0 if self._attn_impl in ("paged", "megakernel") else 3.0
         self.flight.set_cost_model(
@@ -1772,6 +1773,59 @@ class Scheduler:
                     )
                     _, self.cache.k, self.cache.v = self._consume_aux(res)
                     count += 1
+        # Speculative-round executables (draft chunk+sample, γ-1 proposal
+        # window, target chunk scoring, rejection verify): _decode_spec keys
+        # them by (γ, decode bucket, table width), so with a draft attached
+        # the first spec round after warmup would otherwise compile four
+        # executables mid-traffic. All rows inactive/zero-valid, tables
+        # zero → writes land in the reserved scratch block 0, same as the
+        # decode warmup above.
+        if self.draft_params is not None:
+            gamma = self.spec_gamma
+            S = gamma + 1
+            for bucket in self.sc.decode_buckets:
+                for width in widths:
+                    self.flight.record_exec("spec", (gamma, bucket, width))
+                    tables = jnp.zeros((bucket, width), jnp.int32)
+                    temps = jnp.zeros((bucket,), jnp.float32)
+                    tks = jnp.zeros((bucket,), jnp.int32)
+                    tps = jnp.ones((bucket,), jnp.float32)
+                    toks = jnp.zeros((bucket, S), jnp.int32)
+                    pos0 = jnp.zeros((bucket,), jnp.int32)
+                    valid = jnp.zeros((bucket,), jnp.int32)
+                    tok1, lg1, self.draft_cache.k, self.draft_cache.v = (
+                        self._d_chunk_sample_jit(
+                            self.draft_params, self.draft_cache.k, self.draft_cache.v,
+                            toks, pos0, valid, tables, temps, tks, tps, key,
+                        )
+                    )
+                    count += 1
+                    if gamma > 1:
+                        _, lg_steps, self.draft_cache.k, self.draft_cache.v = (
+                            self._d_multi_jit(
+                                self.draft_params, self.draft_cache.k, self.draft_cache.v,
+                                tok1, pos0, tables, jnp.zeros((bucket,), bool),
+                                temps, tks, tps, key,
+                            )
+                        )
+                        draft_logits = jnp.concatenate(
+                            [lg1[:, None], jnp.transpose(lg_steps, (1, 0, 2))], axis=1
+                        )
+                        count += 1
+                    else:
+                        draft_logits = lg1[:, None]
+                    t_logits, self.cache.k, self.cache.v = self._consume_aux(
+                        self._t_chunk_jit(
+                            self.params, self.cache.k, self.cache.v,
+                            toks, pos0, valid, tables,
+                        )
+                    )
+                    self._spec_verify_jit(
+                        draft_logits, t_logits,
+                        jnp.zeros((bucket, gamma), jnp.int32),
+                        temps, tks, tps, key,
+                    )
+                    count += 2
         return count
 
     def _draft_catchup(self, seq: Sequence, tokens: List[int], upto: int) -> None:

@@ -233,7 +233,12 @@ class HttpService:
         # Bind the socket ourselves: aiohttp exposes no public API for the
         # OS-assigned port when port=0 (reaching into site._server.sockets is
         # a private-API trap across versions).
-        sock = _socket.create_server((self.host, self.port), reuse_port=False)
+        # Bind off the loop: create_server resolves the host and binds
+        # synchronously, which can stall an already-serving process loop
+        # (multi-frontend startup, slow resolvers).
+        sock = await asyncio.to_thread(
+            _socket.create_server, (self.host, self.port), reuse_port=False
+        )
         self.port = sock.getsockname()[1]
         site = web.SockSite(self._runner, sock, ssl_context=self._ssl)
         await site.start()

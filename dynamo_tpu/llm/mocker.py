@@ -222,7 +222,13 @@ class MockTpuEngine:
         max_tokens = int(stop.get("max_tokens") or 16)
         deadline_ms = stop.get("deadline_ms")
         self.request_total += 1
-        if not request.get("prefill_done"):
+        # Disagg decode legs are marked "_prefilled" on the engine-plane
+        # wire (disagg.py); the traffic harness's synthetic requests use the
+        # legacy "prefill_done" flag. Honor both so the mocker behaves like
+        # the real engine when it stands in for one behind the disagg
+        # handler ("prefill_done" itself is baselined in dtlint_baseline).
+        prefilled = bool(request.get("prefill_done") or request.get("_prefilled"))
+        if not prefilled:
             # Disagg decode legs carry the prompt for context accounting but
             # prefill none of it — counting their input tokens would double
             # the observer's prefill-demand estimate (rate × ISL).
@@ -231,7 +237,7 @@ class MockTpuEngine:
         seq = _Seq(
             f"mock-{self.request_total}", tokens, max_tokens, context,
             forced=forced, deadline_ms=float(deadline_ms) if deadline_ms else None,
-            prefill_done=bool(request.get("prefill_done")),
+            prefill_done=prefilled,
         )
         self.waiting.append(seq)
         self._ensure_loop()

@@ -1,0 +1,51 @@
+"""ASYNC001 fixture: blocking calls on and off the event loop.
+
+- ``handler``: direct ``time.sleep`` — finding; a suppressed second sleep;
+  the sync helper ``_log_request`` is reached ON the loop, so its
+  ``requests.post`` is a finding at the helper's own line.
+- ``_subtask``: ``asyncio.to_thread(...)`` hands ``_blocking_is_fine`` to a
+  thread — its ``time.sleep`` is sanctioned; the bare ``open()`` in the
+  async body is a finding.
+- ``guarded``: un-timeouted ``_lk.acquire()`` — finding; the timeouted
+  twin right below is clean.
+"""
+
+import asyncio
+import threading
+import time
+
+_lk = threading.Lock()
+
+
+async def handler(req):
+    time.sleep(0.01)  # expect: ASYNC001
+    time.sleep(0.02)  # dtlint: disable=ASYNC001
+    _log_request(req)
+    await _subtask()
+    await asyncio.sleep(0)
+
+
+async def _subtask():
+    await asyncio.to_thread(_blocking_is_fine)
+    with open("/tmp/fx_async001.txt") as fh:  # expect: ASYNC001
+        fh.read()
+
+
+async def guarded():
+    _lk.acquire()  # expect: ASYNC001
+    try:
+        pass
+    finally:
+        _lk.release()
+    if _lk.acquire(timeout=0.1):
+        _lk.release()
+
+
+def _log_request(req):
+    import requests
+
+    requests.post("http://localhost:9", json=req)  # expect: ASYNC001
+
+
+def _blocking_is_fine():
+    time.sleep(0.05)

@@ -1,0 +1,3 @@
+"""ProjectGraph (callgraph v2) fixtures: cross-module import resolution,
+module-typed attribute dispatch, the module-returner registry pattern, and
+the host/device return-class fixpoint."""

@@ -1,0 +1,18 @@
+"""Callgraph fixture — the 'model module' side of the registry pattern."""
+
+
+def host_fn():
+    return 1.0
+
+
+def device_fn(x):
+    return jnp.dot(x, x)  # noqa: F821 - parsed, never imported
+
+
+def chain(x):
+    return device_fn(x)
+
+
+def helper(x):
+    host_fn()
+    return x

@@ -1,0 +1,34 @@
+"""Callgraph fixture — the scheduler side: cross-module call resolution.
+
+``Sched.__init__`` binds a module to ``self.model`` and jits a lambda that
+dispatches through it (the scheduler's real style); ``pick`` is a
+module-returner; ``relay`` returns a device value produced two functions
+away in another module.
+"""
+
+from . import models
+from .models import helper
+
+
+def pick(cfg):
+    if cfg:
+        return models
+    return models
+
+
+def relay(x):
+    return models.chain(x)
+
+
+class Sched:
+    def __init__(self, cfg):
+        self.model = models
+        self._step_jit = jax.jit(lambda x: self.model.device_fn(x))  # noqa: F821
+
+    def step(self, x):
+        y = helper(x)
+        return models.chain(y)
+
+    def route(self, cfg, x):
+        m = pick(cfg)
+        return m.device_fn(x)

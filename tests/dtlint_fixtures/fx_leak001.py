@@ -1,0 +1,53 @@
+"""LEAK001 fixture: allocator lifecycle exits from the live/queued sets.
+
+- ``admit``: waiting→running promotion — clean (queued removal + promote).
+- ``finish``: running removal with an inline release — clean.
+- ``reap``: waiting removal whose release lives one call away — clean.
+- ``drop``: running removal with no release anywhere in its closure — finding.
+- ``leak_alloc``: allocate() return value discarded — finding.
+- ``shed``: same shape as drop but suppressed on the line.
+"""
+
+
+class BlockAllocator:
+    def allocate(self, n):
+        return list(range(n))
+
+    def release(self, ids):
+        del ids
+
+
+class Pool:
+    def __init__(self):
+        self.allocator = BlockAllocator()
+        self.running = []
+        self.waiting = []
+
+    def admit(self, seq):
+        seq.blocks = self.allocator.allocate(2)
+        self.waiting.remove(seq)
+        self.running.append(seq)
+
+    def finish(self, seq):
+        self.running.remove(seq)
+        self.allocator.release(seq.blocks)
+
+    def reap(self, seq):
+        self.waiting.remove(seq)
+        self._free(seq)
+
+    def _free(self, seq):
+        self.allocator.release(seq.blocks)
+
+    def drop(self, seq):
+        self.running.remove(seq)  # expect: LEAK001
+        self._count()
+
+    def leak_alloc(self):
+        self.allocator.allocate(2)  # expect: LEAK001
+
+    def shed(self, seq):
+        self.running.remove(seq)  # dtlint: disable=LEAK001
+
+    def _count(self):
+        return len(self.running)

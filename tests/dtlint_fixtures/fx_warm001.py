@@ -1,0 +1,38 @@
+"""WARM001 fixture: a mini scheduler whose warmup() must cover the serving
+dispatch key space.
+
+- ``decode``: registered by warmup() at matching arity — clean.
+- ``mixed``: registered through a helper warmup() calls; the serving site
+  keys through a local tuple plus a conditional suffix whose arity set
+  intersects the warmed one — clean (exercises the arity-set algebra).
+- ``spec``: never registered by warmup — 1 finding (unwarmed kind).
+- ``admit``: registered, but warmup keys 2-tuples while serving keys
+  3-tuples — 1 finding (arity mismatch).
+"""
+
+
+class FlightRec:
+    def record_exec(self, kind, key):
+        self.last = (kind,) + tuple(key)
+
+
+class Mini:
+    def __init__(self):
+        self.flight = FlightRec()
+        self.decode_buckets = (8, 16)
+
+    def warmup(self):
+        for bucket in self.decode_buckets:
+            self.flight.record_exec("decode", (bucket, 4))
+        self.flight.record_exec("admit", (8, 4))
+        self._warm_mixed()
+
+    def _warm_mixed(self):
+        self.flight.record_exec("mixed", (8, 4, 2))
+
+    def step(self, flag):
+        self.flight.record_exec("decode", (8, 4))
+        mixed_key = (8, 4)
+        self.flight.record_exec("mixed", mixed_key + ((2,) if flag else (1, 2)))
+        self.flight.record_exec("spec", (4, 8, 16))  # expect: WARM001
+        self.flight.record_exec("admit", (8, 4, 2))  # expect: WARM001

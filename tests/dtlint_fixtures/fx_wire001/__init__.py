@@ -1,0 +1,2 @@
+"""WIRE001 fixtures: a mini frontend/worker wire with seeded drift in both
+directions on both channels, plus a mocker with one orphan stats family."""

@@ -1,0 +1,15 @@
+"""WIRE001 fixture — mocker stats parity (channel C).
+
+``good_total`` and ``step_decode_ok_total`` exist on the fixture engine
+plane (aggregator key lists / an emitter f-string wildcard);
+``mock_only_total`` does not — 1 finding.
+"""
+
+
+class Mock:
+    def stats_handler(self):
+        return {
+            "good_total": 1,
+            "step_decode_ok_total": 2,
+            "mock_only_total": 3,  # expect: WIRE001
+        }

@@ -1,0 +1,24 @@
+"""WIRE001 fixture — worker side: wire readers.
+
+``Eng.generate`` reads one key no writer produces (``ghost_field``);
+``StopC.from_dict`` reads one stop sub-key no stop writer sets
+(``ghost_stop``).
+"""
+
+
+class StopC:
+    @classmethod
+    def from_dict(cls, d):
+        limit = d.get("max_tokens")
+        missing = d.get("ghost_stop")  # expect: WIRE001
+        return (limit, missing)
+
+
+class Eng:
+    def generate(self, request, ctx):
+        toks = request.get("token_ids")
+        ann = request["annotations"]
+        stop = request.get("stop_conditions") or {}
+        limit = stop.get("max_tokens")
+        ghost = request.get("ghost_field")  # expect: WIRE001
+        return (toks, ann, limit, ghost)

@@ -1,0 +1,29 @@
+"""WIRE001 fixture — frontend side: wire writers.
+
+``Pre.to_wire`` seeds one dead write (``dead_field``); ``stops`` seeds one
+stop-channel dead write (``phantom_stop``). Everything else has a matching
+reader in ``reader.py``.
+"""
+
+
+def stops(body):
+    limit = body.get("max_tokens")
+    return {
+        "max_tokens": limit,
+        "phantom_stop": True,  # expect: WIRE001
+    }
+
+
+class Pre:
+    def to_wire(self):
+        d = {
+            "token_ids": [1, 2],
+            "dead_field": 0,  # expect: WIRE001
+        }
+        d["stop_conditions"] = stops({})
+        return d
+
+    def transform(self, request, ctx):
+        wire = dict(request)
+        wire["annotations"] = []
+        return wire

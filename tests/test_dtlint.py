@@ -30,6 +30,21 @@ FIXTURE_CONFIG = LintConfig(
     grafana_path=f"{FIXTURES}/fx_met001/grafana.json",
     sync_allowlist_path=f"{FIXTURES}/sync_allowlist.json",
     thread_entries=((f"{FIXTURES}/fx_thr001.py", "Poller.poll"),),
+    # v2 rule anchors, re-pointed at the fixture tree.
+    warmup_scopes=(f"{FIXTURES}/fx_warm001.py",),
+    warmup_func="Mini.warmup",
+    async_scopes=(f"{FIXTURES}/fx_async001.py",),
+    wire_writers=(
+        f"{FIXTURES}/fx_wire001/writer.py::Pre.to_wire",
+        f"{FIXTURES}/fx_wire001/writer.py::Pre.transform",
+    ),
+    wire_readers=(f"{FIXTURES}/fx_wire001/reader.py::Eng.generate",),
+    wire_stop_writers=(f"{FIXTURES}/fx_wire001/writer.py::stops",),
+    wire_stop_readers=(f"{FIXTURES}/fx_wire001/reader.py::StopC.from_dict",),
+    mocker_path=f"{FIXTURES}/fx_wire001/mock.py",
+    # Keep MET001 off the wire fixtures: the mocker mini's stats families
+    # are channel-C subjects, not scrape-registry subjects.
+    met001_exclude=("fx_wire001/",),
 )
 
 
@@ -56,6 +71,12 @@ def fixture_findings(rules=None):
     ("DON001", f"{FIXTURES}/fx_don001.py"),
     ("SYNC001", f"{FIXTURES}/fx_sync001.py"),
     ("THR001", f"{FIXTURES}/fx_thr001.py"),
+    ("WARM001", f"{FIXTURES}/fx_warm001.py"),
+    ("ASYNC001", f"{FIXTURES}/fx_async001.py"),
+    ("LEAK001", f"{FIXTURES}/fx_leak001.py"),
+    ("WIRE001", f"{FIXTURES}/fx_wire001/writer.py"),
+    ("WIRE001", f"{FIXTURES}/fx_wire001/reader.py"),
+    ("WIRE001", f"{FIXTURES}/fx_wire001/mock.py"),
 ])
 def test_rule_catches_fixture_violations_at_exact_lines(rule, fixture):
     found = {
@@ -102,7 +123,8 @@ def test_suppression_comments_silence_only_their_line():
     # Every fixture carries one would-be violation with an inline
     # ``# dtlint: disable=RULE`` — none of those lines may be reported.
     for fixture in (f"{FIXTURES}/fx_jit001.py", f"{FIXTURES}/fx_jit002.py",
-                    f"{FIXTURES}/fx_don001.py", f"{FIXTURES}/fx_sync001.py"):
+                    f"{FIXTURES}/fx_don001.py", f"{FIXTURES}/fx_sync001.py",
+                    f"{FIXTURES}/fx_async001.py", f"{FIXTURES}/fx_leak001.py"):
         src = open(os.path.join(REPO, fixture)).read().splitlines()
         suppressed_lines = {
             i for i, l in enumerate(src, start=1) if "dtlint: disable=" in l
@@ -125,6 +147,87 @@ def test_sync001_allowlist_sanctions_exactly_the_named_sync():
     )
     # off_path() is outside the hot-path scope entirely.
     assert not any(f.qualname == "HotLoop.off_path" for f in findings)
+
+
+def test_warm001_distinguishes_unwarmed_from_arity_drift():
+    keys = {f.key for f in fixture_findings(rules=["WARM001"])}
+    assert keys == {"unwarmed:spec", "arity:admit"}
+
+
+def test_wire001_covers_both_channels_and_directions():
+    keys = {f.key for f in fixture_findings(rules=["WIRE001"])}
+    assert keys == {
+        "ghost-read:request:ghost_field",
+        "dead-write:request:dead_field",
+        "ghost-read:stop_conditions:ghost_stop",
+        "dead-write:stop_conditions:phantom_stop",
+        "mocker-stats:mock_only_total",
+    }
+
+
+def test_sync001_flags_stale_allowlist_entries(tmp_path):
+    """The allowlist can only shrink: entries naming vanished functions or
+    vanished syncs fail the run like a stale baseline would."""
+    stale = {
+        "hot_paths": {f"{FIXTURES}/fx_sync001.py": [
+            "HotLoop.decode_step", "HotLoop.gone",
+        ]},
+        "allowed_syncs": [{
+            "file": f"{FIXTURES}/fx_sync001.py", "func": "HotLoop.decode_step",
+            "call": "np.array", "role": "per_step", "path": "fixture",
+            "reason": "stale: decode_step has no np.array sync",
+        }],
+    }
+    p = tmp_path / "allow.json"
+    p.write_text(json.dumps(stale))
+    cfg = LintConfig(
+        root=REPO, paths=(FIXTURES,), sync_allowlist_path=str(p),
+        warmup_scopes=FIXTURE_CONFIG.warmup_scopes,
+        warmup_func=FIXTURE_CONFIG.warmup_func,
+        async_scopes=FIXTURE_CONFIG.async_scopes,
+    )
+    keys = {f.key for f in run_lint(cfg, rules=["SYNC001"]).findings
+            if f.key.startswith("stale-allowlist:")}
+    assert f"stale-allowlist:hot:{FIXTURES}/fx_sync001.py:HotLoop.gone" in keys
+    assert any(k.startswith("stale-allowlist:call:") for k in keys)
+
+
+# --- the whole-program call graph (v2) ----------------------------------------
+
+def test_project_graph_resolves_cross_module_calls():
+    from tools.dtlint.callgraph import gid, project_graph
+    from tools.dtlint.core import ProjectIndex
+
+    index = ProjectIndex(FIXTURE_CONFIG)
+    pg = project_graph(index)
+    sched = f"{FIXTURES}/fx_callgraph/sched.py"
+    models = f"{FIXTURES}/fx_callgraph/models.py"
+    # from-import and module-attribute call sites both resolve across
+    # module boundaries into real edges.
+    assert gid(models, "helper") in pg.edges[gid(sched, "Sched.step")]
+    assert gid(models, "chain") in pg.edges[gid(sched, "Sched.step")]
+    # jit(lambda x: self.model.device_fn(x)) resolves through the
+    # module-typed attribute to a cross-module jit root.
+    assert gid(models, "device_fn") in pg.jit_roots()
+    # Module-returner registry pattern: m = pick(cfg); m.device_fn(x).
+    assert pg.resolve_call_multi(sched, "Sched.route", "m.device_fn") == {
+        gid(models, "device_fn")
+    }
+
+
+def test_return_class_fixpoint_crosses_modules():
+    from tools.dtlint.callgraph import DEVICE, HOST, gid, project_graph
+    from tools.dtlint.core import ProjectIndex
+
+    index = ProjectIndex(FIXTURE_CONFIG)
+    pg = project_graph(index)
+    rc = pg.infer_return_classes()
+    models = f"{FIXTURES}/fx_callgraph/models.py"
+    sched = f"{FIXTURES}/fx_callgraph/sched.py"
+    assert rc[gid(models, "host_fn")] == HOST
+    assert rc[gid(models, "device_fn")] == DEVICE
+    assert rc[gid(models, "chain")] == DEVICE   # device through a helper...
+    assert rc[gid(sched, "relay")] == DEVICE    # ...and across modules
 
 
 # --- baseline behavior --------------------------------------------------------
@@ -230,10 +333,78 @@ def test_cli_json_exit_codes():
                for f in payload["findings"])
 
 
+def test_cli_github_annotations_from_json(tmp_path):
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dtlint",
+         f"{FIXTURES}/fx_jit001.py", "--rule", "JIT001",
+         "--baseline", "", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 1
+    dump = tmp_path / "findings.json"
+    dump.write_text(out.stdout)
+    # The CI annotation step replays the dump; it decorates but never gates
+    # (the lint step already failed the job), so it exits 0.
+    out2 = subprocess.run(
+        [sys.executable, "-m", "tools.dtlint", "--github",
+         "--from-json", str(dump)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+    assert "::error file=" in out2.stdout
+    assert "title=dtlint JIT001" in out2.stdout
+
+
+def test_cli_diff_mode_runs_clean():
+    # Whatever the working tree's changed-file set is, a tree that is clean
+    # modulo baseline filters down to zero reported findings.
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.dtlint", "--diff"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_rule_registry_is_complete():
+    import tools.dtlint.rules_async  # noqa: F401
     import tools.dtlint.rules_jit  # noqa: F401
+    import tools.dtlint.rules_leak  # noqa: F401
     import tools.dtlint.rules_metrics  # noqa: F401
     import tools.dtlint.rules_sync  # noqa: F401
     import tools.dtlint.rules_threads  # noqa: F401
+    import tools.dtlint.rules_warmup  # noqa: F401
+    import tools.dtlint.rules_wire  # noqa: F401
 
-    assert set(RULES) == {"JIT001", "JIT002", "SYNC001", "DON001", "MET001", "THR001"}
+    assert set(RULES) == {
+        "JIT001", "JIT002", "SYNC001", "DON001", "MET001", "THR001",
+        "WARM001", "ASYNC001", "LEAK001", "WIRE001",
+    }
+
+
+def test_static_warmup_report_agrees_with_the_real_scheduler():
+    """The bench-facing export over the REAL tree: the kinds the scheduler
+    serves are (modulo the baselined open-ended mm bucket) all statically
+    warmed, including the spec-decode round added for exactly this gap."""
+    from tools.dtlint.rules_warmup import static_warmup_report
+
+    report = static_warmup_report(REPO)
+    warmed = report["warmed"]
+    assert "decode" in warmed
+    assert "spec" in warmed, (
+        "spec-round executables fell out of Scheduler.warmup()"
+    )
+    # Every serving-path dispatch kind (modulo the baselined mm bucket) is
+    # statically warmed at an intersecting arity — the same coverage
+    # relation WARM001 enforces, exported here for bench.py's dynamic
+    # cross-check against the flight recorder.
+    for kind, arities in report["serving"].items():
+        if kind == "prefill_mm":
+            continue
+        assert kind in warmed, f"serving kind '{kind}' never warmed"
+        if arities and warmed[kind]:
+            assert set(arities) & set(warmed[kind]), (
+                f"serving kind '{kind}' keys {arities} but warmup "
+                f"registers {warmed[kind]}"
+            )

@@ -2,6 +2,19 @@
 
 Exit codes: 0 = clean (modulo baseline), 1 = findings or stale baseline
 entries, 2 = usage/config error.
+
+Modes beyond the plain run:
+
+- ``--diff``: pre-commit mode. The whole-program analysis still runs over
+  the full tree (cross-module rules are meaningless on a file subset), but
+  findings are *reported* only for files changed vs git HEAD — except for
+  global rules whose anchor files changed (touch the Grafana dashboard and
+  every MET001 finding is in play; touch a wire writer and all of WIRE001
+  is), and any change under tools/dtlint/ itself, which reports everything.
+- ``--github``: emit GitHub Actions ``::error file=...,line=...`` workflow
+  annotations. With ``--from-json FILE`` it annotates from a prior
+  ``--json`` dump without re-linting (the lint step already failed the
+  job; the annotation step just decorates the diff) and always exits 0.
 """
 
 from __future__ import annotations
@@ -9,16 +22,59 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+from typing import Dict, List, Set
 
 from tools.dtlint.core import LintConfig, RULE_DOCS, run_lint
+
+
+def _changed_files(root: str) -> Set[str]:
+    """Repo-relative paths changed vs HEAD (staged + unstaged + untracked)."""
+    out: Set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            out.update(l.strip() for l in res.stdout.splitlines() if l.strip())
+    return out
+
+
+def _global_anchor_map(config: LintConfig) -> Dict[str, Set[str]]:
+    """rule -> anchor files whose change puts the rule's whole finding set
+    in play (cross-file rules relate a changed anchor to unchanged sites)."""
+    wire_paths = {e.partition("::")[0]
+                  for e in (config.wire_writers + config.wire_readers
+                            + config.wire_stop_writers + config.wire_stop_readers)}
+    return {
+        "MET001": {config.aggregator_path, config.grafana_path},
+        "SYNC001": {config.sync_allowlist_path},
+        "WARM001": set(config.warmup_scopes),
+        "WIRE001": wire_paths | {config.aggregator_path, config.mocker_path},
+    }
+
+
+def _github_line(f: dict) -> str:
+    # Annotation messages are single-line; commas/newlines survive but keep
+    # it tidy. The title carries the rule so the annotation list scans well.
+    msg = str(f.get("message", "")).replace("\n", " ")
+    return (f"::error file={f['file']},line={f['line']},"
+            f"title=dtlint {f['rule']}::[{f.get('qualname', '?')}] {msg}")
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tools.dtlint",
         description="static invariant checker (jit hygiene, sync points, "
-                    "donation, metrics drift, thread safety)",
+                    "donation, metrics drift, thread safety, warmup "
+                    "coverage, async safety, KV leaks, wire drift)",
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to scan (default: dynamo_tpu)")
@@ -29,17 +85,48 @@ def main(argv=None) -> int:
                         "dtlint_baseline.json; '' disables)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as JSON on stdout")
+    p.add_argument("--diff", action="store_true",
+                   help="report only findings in files changed vs git HEAD "
+                        "(global rules stay armed when their anchors "
+                        "changed); the analysis itself is whole-tree")
+    p.add_argument("--github", action="store_true",
+                   help="emit GitHub Actions ::error annotations")
+    p.add_argument("--from-json", default=None, metavar="FILE",
+                   help="with --github: annotate from a prior --json dump "
+                        "instead of re-linting (always exits 0)")
     p.add_argument("--root", default=os.getcwd(), help=argparse.SUPPRESS)
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
     args = p.parse_args(argv)
 
     # Importing the rule modules populates the registry for --list-rules.
-    from tools.dtlint import rules_jit, rules_metrics, rules_sync, rules_threads  # noqa: F401
+    from tools.dtlint import (  # noqa: F401
+        rules_async, rules_jit, rules_leak, rules_metrics, rules_sync,
+        rules_threads, rules_warmup, rules_wire,
+    )
 
     if args.list_rules:
         for name in sorted(RULE_DOCS):
             print(f"{name}  {RULE_DOCS[name]}")
+        return 0
+
+    if args.from_json and not args.github:
+        print("dtlint: --from-json requires --github", file=sys.stderr)
+        return 2
+
+    if args.github and args.from_json:
+        try:
+            with open(args.from_json) as fh:
+                dump = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"dtlint: cannot read {args.from_json}: {e}", file=sys.stderr)
+            return 2
+        for f in dump.get("findings", []):
+            print(_github_line(f))
+        for e in dump.get("stale_baseline", []):
+            print(f"::error file={e['file']},title=dtlint stale baseline::"
+                  f"[{e['rule']}/{e['qualname']}/{e['key']}] entry no longer "
+                  f"matches a finding — remove it (reason was: {e['reason']})")
         return 0
 
     rules = None
@@ -62,26 +149,50 @@ def main(argv=None) -> int:
         print(f"dtlint: {e}", file=sys.stderr)
         return 2
 
-    if args.as_json:
+    findings = result.findings
+    stale = result.stale_baseline
+    if args.diff:
+        changed = _changed_files(args.root)
+        if any(c.startswith("tools/dtlint/") or c == "dtlint_baseline.json"
+               for c in changed):
+            pass  # the checker itself changed: everything is in play
+        else:
+            anchors = _global_anchor_map(config)
+            armed = {r for r, files in anchors.items() if files & changed}
+            findings = [f for f in findings
+                        if f.file in changed or f.rule in armed]
+            # Stale baseline entries always report: they mean the tree moved
+            # under the baseline, whatever file this commit touches.
+
+    ok = not findings and not stale
+
+    if args.github:
+        for f in findings:
+            print(_github_line(f.to_json()))
+        for e in stale:
+            print(f"::error file={e['file']},title=dtlint stale baseline::"
+                  f"[{e['rule']}/{e['qualname']}/{e['key']}] entry no longer "
+                  f"matches a finding — remove it (reason was: {e['reason']})")
+    elif args.as_json:
         print(json.dumps({
-            "findings": [f.to_json() for f in result.findings],
-            "stale_baseline": result.stale_baseline,
+            "findings": [f.to_json() for f in findings],
+            "stale_baseline": stale,
             "baseline_size": result.baseline_size,
-            "ok": result.ok,
+            "ok": ok,
         }, indent=2))
     else:
-        for f in result.findings:
+        for f in findings:
             print(f.render())
-        for e in result.stale_baseline:
+        for e in stale:
             print(f"{e['file']}: STALE-BASELINE [{e['rule']}/{e['qualname']}/"
                   f"{e['key']}] no longer matches a finding — remove the "
                   f"entry (reason was: {e['reason']})")
-        n = len(result.findings)
+        n = len(findings)
         print(f"dtlint: {n} finding{'s' if n != 1 else ''}, "
-              f"{len(result.stale_baseline)} stale baseline entr"
-              f"{'ies' if len(result.stale_baseline) != 1 else 'y'} "
+              f"{len(stale)} stale baseline entr"
+              f"{'ies' if len(stale) != 1 else 'y'} "
               f"(baseline: {result.baseline_size})", file=sys.stderr)
-    return 0 if result.ok else 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,19 +1,33 @@
-"""Module-level call graph + jit/pallas root discovery.
+"""Call graphs + jit/pallas root discovery.
 
-Resolution is deliberately module-local and name-based: ``f(...)`` resolves
-to a function defined in the same module, ``self.m(...)`` to a method of
-the enclosing class. That covers how this codebase actually wires its jit
-bodies (kernels and their helpers live beside their ``jax.jit`` /
-``pallas_call`` sites) without pretending to be a type checker.
+Two tiers:
+
+- ``ModuleGraph`` (v1): deliberately module-local and name-based —
+  ``f(...)`` resolves to a function defined in the same module,
+  ``self.m(...)`` to a method of the enclosing class.
+- ``ProjectGraph`` (v2): whole-program. Resolves imports (absolute and
+  relative, aliased), ``self.``/``cls.`` method dispatch including
+  single-level inheritance, class-attribute callables
+  (``self._f_jit = jax.jit(f)`` then ``self._f_jit(...)``), and
+  constructor-/annotation-typed attributes
+  (``self.flight = FlightRecorder()`` then ``self.flight.record_exec``),
+  plus a project-wide fixpoint pass classifying every function's return
+  value as host/device/unknown. This is what lets JIT001/SYNC001/DON001
+  follow the frontend→router→worker→scheduler paths that the module-local
+  graph silently missed, without pretending to be a full type checker:
+  anything it cannot resolve stays unresolved (no guessing).
+
+Functions are identified project-wide by ``"<relpath>::<qualname>"``
+strings (a *gid*).
 """
 
 from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from tools.dtlint.core import SourceModule, dotted, iter_functions
+from tools.dtlint.core import ProjectIndex, SourceModule, dotted, iter_functions
 
 _JIT_CALLS = {"jax.jit", "jit", "pjit", "jax.pjit"}
 _PALLAS_CALLS = {"pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call"}
@@ -37,6 +51,14 @@ class JitWrapper:
     target: Optional[str]          # wrapped function qualname, if resolved
     bound_name: Optional[str]      # "name" or "self.attr" the wrapper binds to
     line: int
+    # Unresolved target reference as a dotted string ("llama.prefill") —
+    # ProjectGraph re-resolves these across module boundaries.
+    target_dotted: Optional[str] = None
+    # When the wrapped object is a lambda (the scheduler's dispatch style:
+    # ``jax.jit(lambda p, k, v: model.decode(...))``), the lambda node and
+    # the enclosing scope — ProjectGraph resolves the calls in its body.
+    target_lambda: Optional[ast.Lambda] = None
+    scope: Optional[str] = None
     static_argnums: Tuple[int, ...] = ()
     static_argnames: Tuple[str, ...] = ()
     donate_argnums: Tuple[int, ...] = ()
@@ -138,9 +160,20 @@ class ModuleGraph:
         if kind is None:
             return None
         target = self._resolve_func_ref(call.args[0], scope) if call.args else None
+        target_dotted = dotted(call.args[0]) if call.args else None
+        target_lambda = None
+        if call.args and isinstance(call.args[0], ast.Lambda):
+            target_lambda = call.args[0]
+        elif call.args and isinstance(call.args[0], ast.Call):
+            # jax.jit(partial(f, ...)): the partial's first arg is the target.
+            inner = call.args[0]
+            if dotted(inner.func) in _PARTIAL and inner.args:
+                target = self._resolve_func_ref(inner.args[0], scope)
+                target_dotted = dotted(inner.args[0])
         kw = {k.arg: k.value for k in call.keywords if k.arg}
         return JitWrapper(
             target=target, bound_name=bound, line=call.lineno, kind=kind,
+            target_dotted=target_dotted, target_lambda=target_lambda, scope=scope,
             static_argnums=self._int_tuple(kw.get("static_argnums")),
             static_argnames=self._str_tuple(kw.get("static_argnames")),
             donate_argnums=self._int_tuple(kw.get("donate_argnums")),
@@ -247,3 +280,553 @@ class ModuleGraph:
         """{bound name: wrapper} for wrappers assigned to a name/attr —
         jitted call sites are calls through these names."""
         return {w.bound_name: w for w in self.wrappers if w.bound_name}
+
+
+# --- whole-program graph (v2) ------------------------------------------------
+
+HOST = "host"
+DEVICE = "device"
+UNKNOWN = "unknown"
+
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.")
+_HOST_BUILTINS = {
+    "len", "range", "sum", "min", "max", "sorted", "list", "tuple", "dict",
+    "set", "zip", "enumerate", "round", "abs", "str", "repr",
+}
+
+
+def gid(relpath: str, qualname: str) -> str:
+    return f"{relpath}::{qualname}"
+
+
+def split_gid(g: str) -> Tuple[str, str]:
+    relpath, _, qualname = g.partition("::")
+    return relpath, qualname
+
+
+def module_name(relpath: str) -> str:
+    """'dynamo_tpu/engine/scheduler.py' -> 'dynamo_tpu.engine.scheduler'."""
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+@dataclass
+class ClassInfo:
+    relpath: str
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)       # dotted base refs
+    methods: Dict[str, str] = field(default_factory=dict)  # method -> gid
+    # self.<attr> typing discovered in the class body:
+    attr_type: Dict[str, str] = field(default_factory=dict)  # attr -> class key
+    attr_func: Dict[str, str] = field(default_factory=dict)  # attr -> gid
+    # attr -> module relpaths, for ``self.model = get_module(cfg)`` where
+    # the callee returns one of a finite set of scanned modules.
+    attr_modules: Dict[str, Set[str]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.relpath}::{self.name}"
+
+
+class ProjectGraph:
+    """Cross-module call graph over every module in a ``ProjectIndex``.
+
+    Built in three passes: (1) collect defs/classes/imports per module,
+    (2) type class attributes from constructor calls, annotations, and
+    typed ``__init__`` params, (3) resolve every call site to a gid where
+    possible and record edges. A final fixpoint pass classifies each
+    function's return value as host/device/unknown for the sync rules.
+    """
+
+    def __init__(self, index: ProjectIndex) -> None:
+        self.index = index
+        self.graphs: Dict[str, ModuleGraph] = {}          # relpath -> ModuleGraph
+        self.by_modname: Dict[str, SourceModule] = {}     # dotted name -> module
+        self.imports: Dict[str, Dict[str, str]] = {}      # relpath -> alias -> dotted target
+        self.funcs: Dict[str, FuncInfo] = {}              # gid -> FuncInfo
+        self.classes: Dict[str, ClassInfo] = {}           # "relpath::Class" -> info
+        self._class_by_name: Dict[str, List[str]] = {}    # Class -> [class keys]
+        self.edges: Dict[str, Set[str]] = {}              # gid -> callee gids
+        self._ret_class: Dict[str, str] = {}
+        for mod in index.modules:
+            self.graphs[mod.relpath] = ModuleGraph(mod)
+            self.by_modname[module_name(mod.relpath)] = mod
+            for q, info in self.graphs[mod.relpath].funcs.items():
+                self.funcs[gid(mod.relpath, q)] = info
+        for mod in index.modules:  # needs by_modname fully populated
+            self.imports[mod.relpath] = self._collect_imports(mod)
+        # gid -> module relpaths: functions whose every return is a scanned
+        # module reference (the ``get_module(config)`` registry pattern).
+        self.module_returners: Dict[str, Set[str]] = {}
+        self._collect_module_returners()
+        # gid -> {local var -> module relpaths} for vars bound from a
+        # module-returner or a module alias.
+        self.var_modules: Dict[str, Dict[str, Set[str]]] = {}
+        self._collect_var_modules()
+        self._collect_classes()
+        self._type_class_attrs()
+        self._collect_edges()
+
+    # -- pass 1: imports ------------------------------------------------------
+    def _collect_imports(self, mod: SourceModule) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        pkg = module_name(mod.relpath).rsplit(".", 1)[0] if "." in module_name(mod.relpath) else ""
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        out[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # Relative import: climb from the containing package.
+                    parts = module_name(mod.relpath).split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + ([node.module] if node.module else []))
+                elif pkg and base.split(".")[0] not in ("dynamo_tpu", "tools") and f"{pkg}.{base}" in self.by_modname:
+                    # Implicit-relative style "from engine import x" (rare).
+                    base = f"{pkg}.{base}"
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+        return out
+
+    def _module_of_ref(self, relpath: str, name: str) -> Optional[str]:
+        """Relpath of the scanned module a dotted reference names, if any
+        (``llama`` via ``from .models import llama``, ``pkg.mod``, ...)."""
+        if not name:
+            return None
+        imp = self.imports.get(relpath, {})
+        head = name.split(".")[0]
+        target = imp[head] + name[len(head):] if head in imp else name
+        mod = self.by_modname.get(target)
+        return mod.relpath if mod is not None else None
+
+    def _collect_module_returners(self) -> None:
+        for g, info in self.funcs.items():
+            relpath, _ = split_gid(g)
+            mods: Set[str] = set()
+            ok = False
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                if isinstance(node.value, (ast.Name, ast.Attribute)):
+                    m = self._module_of_ref(relpath, dotted(node.value))
+                    if m is not None:
+                        mods.add(m)
+                        ok = True
+                        continue
+                ok = False
+                break
+            if ok and mods:
+                self.module_returners[g] = mods
+
+    def _collect_var_modules(self) -> None:
+        for g, info in self.funcs.items():
+            relpath, q = split_gid(g)
+            out: Dict[str, Set[str]] = {}
+            for node in ast.walk(info.node):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                var = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    callee = self._resolve_func(relpath, q, dotted(node.value.func))
+                    if callee in self.module_returners:
+                        out[var] = set(self.module_returners[callee])
+                elif isinstance(node.value, (ast.Name, ast.Attribute)):
+                    m = self._module_of_ref(relpath, dotted(node.value))
+                    if m is not None:
+                        out[var] = {m}
+            if out:
+                self.var_modules[g] = out
+
+    # -- pass 2: classes + attribute typing -----------------------------------
+    def _collect_classes(self) -> None:
+        for mod in self.index.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                info = ClassInfo(
+                    relpath=mod.relpath, name=node.name, node=node,
+                    bases=[dotted(b) for b in node.bases if dotted(b)],
+                )
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info.methods[item.name] = gid(mod.relpath, f"{node.name}.{item.name}")
+                self.classes[info.key] = info
+                self._class_by_name.setdefault(node.name, []).append(info.key)
+
+    def _resolve_class(self, relpath: str, name: str) -> Optional[str]:
+        """Resolve a dotted class reference visible from ``relpath``."""
+        if not name:
+            return None
+        local = f"{relpath}::{name}"
+        if local in self.classes:
+            return local
+        imp = self.imports.get(relpath, {})
+        head = name.split(".")[0]
+        if head in imp:
+            target = imp[head] + name[len(head):]
+            modpath, _, clsname = target.rpartition(".")
+            mod = self.by_modname.get(modpath)
+            if mod is not None:
+                key = f"{mod.relpath}::{clsname}"
+                if key in self.classes:
+                    return key
+            # ``import pkg.mod`` then ``pkg.mod.Class``
+            mod = self.by_modname.get(target.rpartition(".")[0])
+        # Unique class name anywhere in the tree (last resort, unambiguous only).
+        cands = self._class_by_name.get(name.rpartition(".")[2], [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _resolve_func(self, relpath: str, scope: Optional[str], name: str) -> Optional[str]:
+        """Resolve a dotted function reference from ``relpath``/``scope`` to
+        a gid: local defs, imported functions, ``mod.f``, ``Class.m``."""
+        if not name:
+            return None
+        graph = self.graphs[relpath]
+        local = graph._resolve_func_ref(_name_node(name), scope)
+        if local:
+            return gid(relpath, local)
+        imp = self.imports.get(relpath, {})
+        head, _, rest = name.partition(".")
+        if head in imp:
+            target = imp[head] + (("." + rest) if rest else "")
+            modpath, _, fname = target.rpartition(".")
+            mod = self.by_modname.get(modpath)
+            if mod is not None and fname in self.graphs[mod.relpath].funcs:
+                return gid(mod.relpath, fname)
+            # from x import Class; Class.m / Class(...)
+            ck = self._resolve_class(relpath, head)
+            if ck is not None:
+                info = self.classes[ck]
+                if rest in info.methods:
+                    return info.methods[rest]
+                if not rest:
+                    return info.methods.get("__init__")
+        # Class.m / Class(...) with a locally defined class.
+        ck = self._resolve_class(relpath, head)
+        if ck is not None:
+            info = self.classes[ck]
+            if rest and rest in info.methods:
+                return info.methods[rest]
+            if not rest and "__init__" in info.methods:
+                return info.methods["__init__"]
+        return None
+
+    def _method_on(self, class_key: str, method: str, depth: int = 0) -> Optional[str]:
+        """Method lookup with single-level (transitively capped) MRO walk."""
+        info = self.classes.get(class_key)
+        if info is None or depth > 4:
+            return None
+        if method in info.methods:
+            return info.methods[method]
+        for base in info.bases:
+            bk = self._resolve_class(info.relpath, base)
+            if bk is not None:
+                hit = self._method_on(bk, method, depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _type_class_attrs(self) -> None:
+        for key, info in self.classes.items():
+            relpath = info.relpath
+            ann_of_param: Dict[str, Dict[str, str]] = {}
+            for item in info.node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                # param -> annotated class key (for `self.x = param`).
+                pann: Dict[str, str] = {}
+                for p in item.args.posonlyargs + item.args.args + item.args.kwonlyargs:
+                    if p.annotation is not None:
+                        aname = dotted(p.annotation)
+                        if not aname and isinstance(p.annotation, ast.Constant) and isinstance(p.annotation.value, str):
+                            aname = p.annotation.value
+                        if not aname and isinstance(p.annotation, ast.Subscript):
+                            # Optional[Scheduler] / "Optional[Scheduler]"
+                            inner = dotted(p.annotation.slice)
+                            aname = inner
+                        ck = self._resolve_class(relpath, aname) if aname else None
+                        if ck is not None:
+                            pann[p.arg] = ck
+                ann_of_param[item.name] = pann
+                scope = f"{info.name}.{item.name}"
+                for node in ast.walk(item):
+                    tgt = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        tgt, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                        tgt, val = node.target, node.value
+                    else:
+                        continue
+                    if not (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    attr = tgt.attr
+                    if isinstance(val, ast.Call):
+                        callee = dotted(val.func)
+                        ck = self._resolve_class(relpath, callee)
+                        if ck is not None:
+                            info.attr_type.setdefault(attr, ck)
+                            continue
+                        # self.x = jax.jit(f): route calls through the attr
+                        # to the wrapped function.
+                        if callee in _JIT_CALLS | _PALLAS_CALLS and val.args:
+                            fg = self._resolve_func(relpath, scope, dotted(val.args[0]))
+                            if fg is not None:
+                                info.attr_func.setdefault(attr, fg)
+                            continue
+                        # self.model = get_module(cfg): module-set typing.
+                        cg = self._resolve_func(relpath, scope, callee)
+                        if cg in self.module_returners:
+                            info.attr_modules.setdefault(attr, set()).update(
+                                self.module_returners[cg])
+                            continue
+                    ref = dotted(val)
+                    if ref in pann:  # self.x = typed-param
+                        info.attr_type.setdefault(attr, pann[ref])
+                        continue
+                    m = self._module_of_ref(relpath, ref) if ref else None
+                    if m is not None:  # self.model = llama
+                        info.attr_modules.setdefault(attr, {m})
+                        continue
+                    fg = self._resolve_func(relpath, scope, ref) if ref else None
+                    if fg is not None:
+                        info.attr_func.setdefault(attr, fg)
+                # AnnAssign without value: `self.x: Scheduler`
+                for node in ast.walk(item):
+                    if (isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Attribute)
+                            and isinstance(node.target.value, ast.Name)
+                            and node.target.value.id == "self"):
+                        aname = dotted(node.annotation)
+                        ck = self._resolve_class(relpath, aname) if aname else None
+                        if ck is not None:
+                            info.attr_type.setdefault(node.target.attr, ck)
+
+    # -- pass 3: call resolution ----------------------------------------------
+    def resolve_call(self, relpath: str, scope: str, name: str) -> Optional[str]:
+        """Resolve one dotted call-site name to a callee gid (or None).
+        ``scope`` is the caller's qualname in ``relpath``."""
+        if not name:
+            return None
+        cls_name = scope.rsplit(".", 2)[-2] if "." in scope else None
+        class_key = f"{relpath}::{cls_name}" if cls_name else None
+        if name.startswith(("self.", "cls.")):
+            rest = name.split(".", 1)[1]
+            if class_key and class_key in self.classes:
+                head, _, tail = rest.partition(".")
+                if not tail:
+                    hit = self._method_on(class_key, head)
+                    if hit is not None:
+                        return hit
+                    # class-attribute callable: self._f(...)
+                    fg = self.classes[class_key].attr_func.get(head)
+                    if fg is not None:
+                        return fg
+                else:
+                    # self.attr.m(...): typed attribute dispatch.
+                    ck = self.classes[class_key].attr_type.get(head)
+                    if ck is not None:
+                        return self._method_on(ck, tail.split(".")[0])
+            return None
+        # typed-parameter dispatch: p.m(...) where p: Class
+        head, _, tail = name.partition(".")
+        fn = self.funcs.get(gid(relpath, scope))
+        if tail and fn is not None:
+            for p in fn.node.args.posonlyargs + fn.node.args.args + fn.node.args.kwonlyargs:
+                if p.arg == head and p.annotation is not None:
+                    ck = self._resolve_class(relpath, dotted(p.annotation))
+                    if ck is not None:
+                        return self._method_on(ck, tail.split(".")[0])
+        return self._resolve_func(relpath, scope, name)
+
+    def resolve_call_multi(self, relpath: str, scope: str, name: str) -> Set[str]:
+        """Like ``resolve_call`` but returns every candidate callee — the
+        extra candidates come from module-set typed names (``model.decode``
+        where ``model = get_module(cfg)`` may be any registry module)."""
+        out: Set[str] = set()
+        one = self.resolve_call(relpath, scope, name)
+        if one is not None:
+            out.add(one)
+        head, _, tail = name.partition(".") if name else ("", "", "")
+        if not tail:
+            return out
+        fname = tail.split(".")[0]
+        mods: Set[str] = set()
+        if head in ("self", "cls"):
+            cls_name = scope.rsplit(".", 2)[-2] if "." in scope else None
+            info = self.classes.get(f"{relpath}::{cls_name}") if cls_name else None
+            attr, _, meth = tail.partition(".")
+            if info is not None and meth:
+                mods = info.attr_modules.get(attr, set())
+                fname = meth.split(".")[0]
+        else:
+            mods = self.var_modules.get(gid(relpath, scope), {}).get(head, set())
+        for m in mods:
+            if fname in self.graphs[m].funcs:
+                out.add(gid(m, fname))
+        return out
+
+    def _collect_edges(self) -> None:
+        for relpath, graph in self.graphs.items():
+            for q, info in graph.funcs.items():
+                g = gid(relpath, q)
+                out = self.edges.setdefault(g, set())
+                for node in ast.walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee in self.resolve_call_multi(relpath, q, dotted(node.func)):
+                        if callee != g:
+                            out.add(callee)
+                    # function references passed as args stay reachable
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if isinstance(arg, (ast.Name, ast.Attribute)):
+                            ref = self.resolve_call(relpath, q, dotted(arg))
+                            if ref and ref != g:
+                                out.add(ref)
+
+    # -- queries --------------------------------------------------------------
+    def jit_roots(self) -> Set[str]:
+        """Every jit/pallas wrapper target across the tree, with unresolved
+        (cross-module) targets re-resolved project-wide."""
+        roots: Set[str] = set()
+        for relpath, graph in self.graphs.items():
+            for w in graph.wrappers:
+                if w.target:
+                    roots.add(gid(relpath, w.target))
+                elif w.target_lambda is not None:
+                    # jit(lambda ...: model.decode(...)): every call in the
+                    # lambda body traces into the executable.
+                    scope = w.scope or "<module>"
+                    for node in ast.walk(w.target_lambda.body):
+                        if isinstance(node, ast.Call):
+                            roots |= self.resolve_call_multi(
+                                relpath, scope, dotted(node.func))
+                elif w.target_dotted:
+                    g = self._resolve_func(relpath, w.scope, w.target_dotted)
+                    if g is None:
+                        g = self.resolve_call(
+                            relpath, w.scope or "<module>", w.target_dotted)
+                    if g is not None:
+                        roots.add(g)
+        return roots
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.funcs]
+        while stack:
+            g = stack.pop()
+            if g in seen:
+                continue
+            seen.add(g)
+            stack.extend(self.edges.get(g, ()) - seen)
+        return seen
+
+    def reachable_from_jit(self) -> Set[str]:
+        return self.reachable(self.jit_roots())
+
+    # -- fixpoint return classification ---------------------------------------
+    def _classify_primitive_call(self, relpath: str, scope: str, call: ast.Call) -> str:
+        name = dotted(call.func)
+        if not name:
+            return UNKNOWN
+        if name in ("jax.device_get", "device_get") or name.startswith(("np.", "numpy.")):
+            return HOST
+        if name in _HOST_BUILTINS or name.startswith(("time.", "os.", "math.", "json.")):
+            return HOST
+        if name.startswith(_DEVICE_PREFIXES):
+            return DEVICE
+        if name.split(".")[-1].endswith("_jit"):
+            return DEVICE
+        callee = self.resolve_call(relpath, scope, name)
+        if callee is not None:
+            return self._ret_class.get(callee, UNKNOWN)
+        return UNKNOWN
+
+    def _classify_return_expr(self, relpath: str, scope: str, expr: ast.AST) -> str:
+        if expr is None or isinstance(expr, ast.Constant):
+            return HOST
+        if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                             ast.SetComp, ast.GeneratorExp, ast.JoinedStr, ast.Compare,
+                             ast.BoolOp)):
+            return HOST
+        if isinstance(expr, ast.Tuple):
+            kinds = {self._classify_return_expr(relpath, scope, e) for e in expr.elts}
+            if DEVICE in kinds:
+                return DEVICE
+            if UNKNOWN in kinds:
+                return UNKNOWN
+            return HOST
+        if isinstance(expr, ast.Call):
+            return self._classify_primitive_call(relpath, scope, expr)
+        if isinstance(expr, ast.BinOp):
+            l = self._classify_return_expr(relpath, scope, expr.left)
+            r = self._classify_return_expr(relpath, scope, expr.right)
+            if DEVICE in (l, r):
+                return DEVICE
+            if UNKNOWN in (l, r):
+                return UNKNOWN
+            return HOST
+        return UNKNOWN
+
+    def infer_return_classes(self, max_iter: int = 8) -> Dict[str, str]:
+        """{gid: host|device|unknown} for every function's return value,
+        iterated to fixpoint so helper-through-helper device values are
+        classified across module boundaries."""
+        if self._ret_class:
+            return self._ret_class
+        self._ret_class = {g: UNKNOWN for g in self.funcs}
+        for _ in range(max_iter):
+            changed = False
+            for g, info in self.funcs.items():
+                relpath, q = split_gid(g)
+                kinds: Set[str] = set()
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Return):
+                        kinds.add(self._classify_return_expr(relpath, q, node.value))
+                if not kinds:
+                    new = HOST  # no return statement -> returns None
+                elif DEVICE in kinds:
+                    new = DEVICE
+                elif UNKNOWN in kinds:
+                    new = UNKNOWN
+                else:
+                    new = HOST
+                if new != self._ret_class[g]:
+                    self._ret_class[g] = new
+                    changed = True
+            if not changed:
+                break
+        return self._ret_class
+
+
+def _name_node(dotted_name: str) -> ast.AST:
+    """Rebuild a Name/Attribute node from a dotted string (for reusing the
+    module-local resolver on plain strings)."""
+    parts = dotted_name.split(".")
+    node: ast.AST = ast.Name(id=parts[0], ctx=ast.Load())
+    for p in parts[1:]:
+        node = ast.Attribute(value=node, attr=p, ctx=ast.Load())
+    return node
+
+
+_PROJECT_GRAPH_CACHE: Dict[int, ProjectGraph] = {}
+
+
+def project_graph(index: ProjectIndex) -> ProjectGraph:
+    """Memoized ProjectGraph per index: several rules share one build."""
+    key = id(index)
+    if key not in _PROJECT_GRAPH_CACHE:
+        _PROJECT_GRAPH_CACHE.clear()  # one live index at a time
+        _PROJECT_GRAPH_CACHE[key] = ProjectGraph(index)
+    return _PROJECT_GRAPH_CACHE[key]

@@ -127,6 +127,62 @@ class LintConfig:
     met001_exclude: Tuple[str, ...] = (
         "llm/kv_router", "llm/http", "deploy/", "runtime/metrics.py",
     )
+    # WARM001: files whose record_exec dispatch sites define the serving
+    # key space, and the function that must register each kind at warmup.
+    warmup_scopes: Tuple[str, ...] = (
+        "dynamo_tpu/engine/scheduler.py", "dynamo_tpu/engine/models/llama.py",
+    )
+    warmup_func: str = "Scheduler.warmup"
+    # ASYNC001: path fragments whose ``async def`` bodies serve traffic —
+    # a blocking call reachable from one stalls every request on the loop.
+    async_scopes: Tuple[str, ...] = (
+        "dynamo_tpu/frontend.py", "dynamo_tpu/llm/http/",
+        "dynamo_tpu/runtime/component.py", "dynamo_tpu/runtime/push_router.py",
+        "dynamo_tpu/runtime/health.py", "dynamo_tpu/planner/fleet.py",
+        "dynamo_tpu/planner/observer.py", "dynamo_tpu/llm/mocker.py",
+        "dynamo_tpu/llm/disagg.py", "dynamo_tpu/llm/migration.py",
+        "dynamo_tpu/engine/engine.py", "dynamo_tpu/llm/preprocessor.py",
+    )
+    # WIRE001: who writes request fields onto the wire and who reads them
+    # off. Entries are function-scoped ("path::qualname") because receiver
+    # names collide across protocol layers — the preprocessor's ``request``
+    # parameter is the OpenAI body in transform_request but the wire dict in
+    # transform_response. The stop_* pairs anchor the nested stop_conditions
+    # sub-channel whose writer and reader live three hops apart.
+    wire_writers: Tuple[str, ...] = (
+        "dynamo_tpu/llm/protocols/common.py::PreprocessedRequest.to_wire",
+        "dynamo_tpu/llm/preprocessor.py::OpenAIPreprocessor.transform_request",
+        "dynamo_tpu/llm/preprocessor.py::OpenAIPreprocessor.preprocess",
+        "dynamo_tpu/llm/disagg.py::DisaggDecodeHandler.generate",
+        "dynamo_tpu/llm/migration.py::_MigrationEngine._fold",
+        "dynamo_tpu/llm/multimodal.py::EncodeOperator.transform_request",
+        # Response direction: engine/mocker output frames and their schema.
+        "dynamo_tpu/llm/protocols/common.py::LLMEngineOutput.to_wire",
+        "dynamo_tpu/engine/engine.py::TpuEngine.generate",
+        "dynamo_tpu/llm/mocker.py::MockTpuEngine._sim_loop",
+    )
+    wire_readers: Tuple[str, ...] = (
+        "dynamo_tpu/engine/engine.py::TpuEngine.generate",
+        "dynamo_tpu/llm/mocker.py::MockTpuEngine.generate",
+        "dynamo_tpu/llm/backend.py::Backend.transform_request",
+        "dynamo_tpu/llm/backend.py::Backend.transform_response",
+        "dynamo_tpu/llm/protocols/common.py::PreprocessedRequest.from_wire",
+        "dynamo_tpu/llm/protocols/common.py::LLMEngineOutput.from_wire",
+        "dynamo_tpu/llm/preprocessor.py::OpenAIPreprocessor.transform_response",
+        "dynamo_tpu/llm/migration.py::_MigrationEngine.generate",
+        "dynamo_tpu/llm/migration.py::_MigrationEngine._fold",
+        "dynamo_tpu/llm/disagg.py::DisaggDecodeHandler.generate",
+        "dynamo_tpu/llm/kv_router/__init__.py::KvPushRouter.generate",
+    )
+    wire_stop_writers: Tuple[str, ...] = (
+        "dynamo_tpu/llm/protocols/openai.py::stop_conditions_from_request",
+    )
+    wire_stop_readers: Tuple[str, ...] = (
+        "dynamo_tpu/engine/scheduler.py::StopConditions.from_dict",
+    )
+    # WIRE001 mocker parity: the mocker's stats families must be a subset
+    # of the real engine plane's.
+    mocker_path: str = "dynamo_tpu/llm/mocker.py"
 
     def abspath(self, rel: str) -> str:
         return os.path.join(self.root, rel)
@@ -297,7 +353,10 @@ def run_lint(
     baseline_path: Optional[str] = None,
 ) -> LintResult:
     # Import registers the rules (they live in sibling modules).
-    from tools.dtlint import rules_jit, rules_metrics, rules_sync, rules_threads  # noqa: F401
+    from tools.dtlint import (  # noqa: F401
+        rules_async, rules_jit, rules_leak, rules_metrics, rules_sync,
+        rules_threads, rules_warmup, rules_wire,
+    )
 
     index = ProjectIndex(config)
     names = list(rules) if rules else sorted(RULES)

@@ -1,0 +1,180 @@
+"""ASYNC001 — blocking calls reachable from serving-path ``async def``s.
+
+The frontend, router, component endpoints, health plane, and fleet planner
+all share one event loop per process; a single blocking call anywhere in an
+``async def``'s synchronous call closure stalls *every* in-flight request
+on that loop — the failure mode is invisible under light load and a
+latency cliff under real traffic. The rule walks the whole-program call
+graph (v2) from every ``async def`` in the configured serving scopes and
+flags:
+
+- ``time.sleep`` (use ``asyncio.sleep``),
+- sync network IO (``subprocess.*``, ``urllib.request.urlopen``,
+  ``requests.*``, ``socket.create_connection/create_server``,
+  sock ``.accept()/.connect()``),
+- un-timeouted ``lock.acquire()`` (a contended lock parks the loop),
+- SYNC001-class device syncs (``block_until_ready``, ``jax.device_get``)
+  — a device sync on the event loop serializes the loop against the TPU,
+- bare ``open()`` directly in the async body (file IO off the loop).
+
+Call edges through ``asyncio.to_thread``/``run_in_executor``/
+``Thread(target=...)``/executor ``submit`` are NOT followed: work handed
+to a thread is the sanctioned way to block. Nested ``def``s inside an
+async body are likewise skipped at the top level (they are scanned only
+if actually called on the loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.dtlint.callgraph import gid, project_graph, split_gid
+from tools.dtlint.core import Finding, ProjectIndex, dotted, rule
+
+_OFFLOADERS_EXACT = {"asyncio.to_thread", "threading.Thread", "Thread"}
+_OFFLOADERS_TAIL = {"run_in_executor", "submit", "start_soon", "to_thread"}
+
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep() parks the event loop — use asyncio.sleep()",
+    "urllib.request.urlopen": "sync HTTP on the event loop",
+    "socket.create_connection": "sync socket connect on the event loop",
+    "_socket.create_connection": "sync socket connect on the event loop",
+    "socket.create_server": "sync socket bind/listen on the event loop",
+    "_socket.create_server": "sync socket bind/listen on the event loop",
+    "jax.device_get": "device sync on the event loop serializes loop against device",
+}
+_BLOCKING_PREFIXES = {
+    "subprocess.": "sync subprocess call on the event loop",
+    "requests.": "sync HTTP (requests) on the event loop",
+}
+_SOCK_METHODS = {"accept", "connect", "recv", "recvfrom", "sendall"}
+_LOCKISH = ("lock", "_lk", "sem", "mutex", "cond")
+
+
+def _shallow_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested def bodies."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_calls(fn: ast.AST, direct_async: bool) -> List[Tuple[int, str, str]]:
+    """(line, call, why) blocking calls at this function's own depth."""
+    out: List[Tuple[int, str, str]] = []
+    for node in _shallow_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        tail = name.split(".")[-1]
+        recv = name.rsplit(".", 1)[0].lower() if "." in name else ""
+        if name in _BLOCKING_EXACT:
+            out.append((node.lineno, name, _BLOCKING_EXACT[name]))
+            continue
+        hit = False
+        for pre, why in _BLOCKING_PREFIXES.items():
+            if name.startswith(pre):
+                out.append((node.lineno, name, why))
+                hit = True
+                break
+        if hit:
+            continue
+        if tail == "block_until_ready":
+            out.append((node.lineno, name,
+                        "device sync on the event loop serializes loop against device"))
+        elif tail == "sleep" and name.split(".")[0] not in ("asyncio", "anyio", "trio"):
+            if name == "sleep" or recv in ("time",):
+                out.append((node.lineno, name, "blocking sleep on the event loop"))
+        elif tail in _SOCK_METHODS and any(s in recv for s in ("sock", "conn")):
+            out.append((node.lineno, name, "sync socket IO on the event loop"))
+        elif tail == "acquire" and any(s in recv for s in _LOCKISH):
+            kw = {k.arg for k in node.keywords}
+            has_nonblocking = "timeout" in kw or "blocking" in kw or node.args
+            if not has_nonblocking:
+                out.append((node.lineno, name,
+                            "un-timeouted lock.acquire() can park the loop "
+                            "indefinitely — pass timeout= or use an asyncio lock"))
+        elif name == "open" and direct_async:
+            out.append((node.lineno, name,
+                        "sync file IO directly in an async body — offload via "
+                        "asyncio.to_thread or read outside the handler"))
+    return out
+
+
+def _loop_edges(pg, relpath: str, q: str) -> Set[str]:
+    """Call edges that stay ON the event loop: like the v2 graph's edges
+    but skipping anything routed through a thread/executor offloader."""
+    info = pg.funcs.get(gid(relpath, q))
+    if info is None:
+        return set()
+    out: Set[str] = set()
+    for node in _shallow_walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if name in _OFFLOADERS_EXACT or tail in _OFFLOADERS_TAIL:
+            continue  # args run on a thread, not the loop
+        out |= pg.resolve_call_multi(relpath, q, name)
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                out |= pg.resolve_call_multi(relpath, q, dotted(arg))
+    # nested defs called at this depth are already resolved above; thread
+    # targets were skipped with their offloader call.
+    return out
+
+
+@rule("ASYNC001", "blocking calls (sleep/sync IO/un-timeouted acquire/device syncs) reachable from serving-path async defs")
+def async001(index: ProjectIndex) -> List[Finding]:
+    cfg = index.config
+    pg = project_graph(index)
+
+    roots: List[str] = []
+    for mod in index.modules:
+        if not any(s in mod.relpath for s in cfg.async_scopes):
+            continue
+        for g, info in pg.graphs[mod.relpath].funcs.items():
+            if isinstance(info.node, ast.AsyncFunctionDef):
+                roots.append(gid(mod.relpath, g))
+    if not roots:
+        return []
+
+    # BFS over on-loop edges only.
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        g = stack.pop()
+        if g in seen or g not in pg.funcs:
+            continue
+        seen.add(g)
+        relpath, q = split_gid(g)
+        stack.extend(_loop_edges(pg, relpath, q) - seen)
+
+    root_set = set(roots)
+    findings: List[Finding] = []
+    emitted: Set[Tuple[str, int, str]] = set()
+    for g in sorted(seen):
+        relpath, q = split_gid(g)
+        mod = index.module(relpath)
+        if mod is None:
+            continue
+        info = pg.funcs[g]
+        direct_async = g in root_set or isinstance(info.node, ast.AsyncFunctionDef)
+        for line, call, why in _blocking_calls(info.node, direct_async):
+            if (relpath, line, call) in emitted:
+                continue
+            if mod.suppressed("ASYNC001", line):
+                continue
+            emitted.add((relpath, line, call))
+            findings.append(Finding(
+                "ASYNC001", relpath, line, q,
+                f"{call}() reachable from a serving-path async def — {why}",
+                key=f"blocking:{call}",
+            ))
+    return findings

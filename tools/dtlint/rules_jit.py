@@ -23,7 +23,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from tools.dtlint.callgraph import ModuleGraph
+from tools.dtlint.callgraph import ModuleGraph, project_graph, split_gid
 from tools.dtlint.core import (
     Finding, ProjectIndex, dotted, enclosing_map, qualname_at, rule,
 )
@@ -84,11 +84,19 @@ def _local_bindings(fn: ast.AST) -> Set[str]:
 @rule("JIT001", "host impurity (time/random/logging/print, mutable-global reads) inside jit/pallas bodies")
 def jit001(index: ProjectIndex) -> List[Finding]:
     findings: List[Finding] = []
+    # Whole-program reachability (v2): a scheduler-side jax.jit(lambda:
+    # model.decode(...)) pulls llama.py's decode stack into scope even
+    # though llama.py itself contains no jit call.
+    pg = project_graph(index)
+    reach_by_mod: Dict[str, Set[str]] = {}
+    for g in pg.reachable_from_jit():
+        relpath, q = split_gid(g)
+        reach_by_mod.setdefault(relpath, set()).add(q)
     for mod in index.modules:
-        graph = ModuleGraph(mod)
-        reach = graph.reachable_from_jit()
+        reach = reach_by_mod.get(mod.relpath, set())
         if not reach:
             continue
+        graph = pg.graphs[mod.relpath]
         mut_globals = _mutable_globals(mod.tree)
         for q in sorted(reach):
             info = graph.funcs.get(q)

@@ -1,0 +1,159 @@
+"""LEAK001 — KV block lifecycle: every exit from the live set must free.
+
+The chaos suite proves allocator balance *dynamically* for the 24 fault
+scenarios it scripts; this is the static form. In any class that owns a
+block allocator (a ``self.<alloc>.allocate(...)`` caller), a sequence
+leaving the live set without its blocks being released is a permanent KV
+leak — the pool shrinks until admission stalls. Two checks:
+
+- **(a) discarded allocation**: a bare expression-statement
+  ``self.allocator.allocate(n)`` throws away the returned block ids — the
+  blocks are live in the allocator's accounting but unreachable from any
+  sequence, unfreeable forever.
+- **(b) removal without release**: a method that removes a sequence from a
+  *live* container (``running``/``active``/``live``/``inflight``) must
+  reach an allocator ``release``/``free`` somewhere in its call closure
+  (finish, deadline sweep, preemption, drain, migration export all do).
+  Removals from *queued* containers (``waiting``/``pending``/``queued``)
+  additionally pass if the closure promotes the sequence into another
+  container (admission's waiting→running move) — queued sequences may
+  hold prefix-cached blocks, so a reap from waiting still frees.
+
+Exception paths: the closure check covers every named exit the scheduler
+has; a release inside a ``finally``/``except`` body counts like any other.
+What the rule cannot see — conditional leaks where release exists in the
+closure but a branch skips it — stays the chaos suite's job; the rule
+keeps the *structural* invariant (every exit path has a free in reach).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dtlint.callgraph import gid, project_graph, split_gid
+from tools.dtlint.core import (
+    Finding, ProjectIndex, dotted, enclosing_map, qualname_at, rule,
+)
+
+_LIVE_CONTAINERS = {"running", "active", "live", "inflight", "in_flight", "sequences"}
+_QUEUED_CONTAINERS = {"waiting", "pending", "queued"}
+_REMOVERS = {"remove", "pop", "popleft", "discard"}
+_RELEASERS = {"release", "free", "release_blocks", "free_blocks"}
+_PROMOTERS = {"append", "insert", "appendleft", "add"}
+
+
+def _alloc_attr(name: str) -> bool:
+    return "alloc" in name.lower()
+
+
+def _owning_classes(mod) -> Dict[str, ast.ClassDef]:
+    """Classes in a module that call ``self.<alloc>.allocate(...)``."""
+    out: Dict[str, ast.ClassDef] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "allocate"):
+                base = dotted(sub.func.value)
+                if base.startswith("self.") and _alloc_attr(base):
+                    out[node.name] = node
+                    break
+    return out
+
+
+def _closure_has(pg, index: ProjectIndex, root: str,
+                 pred, max_nodes: int = 400) -> bool:
+    """True if any function in ``root``'s call closure satisfies ``pred``
+    (pred takes the function's ast node)."""
+    seen: Set[str] = set()
+    stack = [root]
+    while stack and len(seen) < max_nodes:
+        g = stack.pop()
+        if g in seen or g not in pg.funcs:
+            continue
+        seen.add(g)
+        if pred(pg.funcs[g].node):
+            return True
+        stack.extend(pg.edges.get(g, ()) - seen)
+    return False
+
+
+def _has_release(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RELEASERS):
+            base = dotted(node.func.value)
+            if _alloc_attr(base) or base.startswith("self."):
+                return True
+    return False
+
+
+def _has_promote(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _PROMOTERS):
+            base = dotted(node.func.value)
+            tail = base.split(".")[-1]
+            if base.startswith("self.") and (
+                tail in _LIVE_CONTAINERS or tail in _QUEUED_CONTAINERS
+            ):
+                return True
+    return False
+
+
+@rule("LEAK001", "allocator acquires that can leave the live set without a release on some exit path")
+def leak001(index: ProjectIndex) -> List[Finding]:
+    pg = project_graph(index)
+    findings: List[Finding] = []
+    for mod in index.modules:
+        owners = _owning_classes(mod)
+        if not owners:
+            continue
+        line_map = enclosing_map(mod.tree)
+        for cls_name, cls in owners.items():
+            for node in ast.walk(cls):
+                # (a) allocation result discarded.
+                if (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "allocate"):
+                    base = dotted(node.value.func.value)
+                    if base.startswith("self.") and _alloc_attr(base):
+                        if not mod.suppressed("LEAK001", node.lineno):
+                            findings.append(Finding(
+                                "LEAK001", mod.relpath, node.lineno,
+                                qualname_at(line_map, node.lineno),
+                                f"return value of {base}.allocate() discarded — "
+                                f"the blocks are unreachable and can never be "
+                                f"released (permanent pool shrink)",
+                                key="discarded-allocate",
+                            ))
+                    continue
+                # (b) live-set removal without a release in reach.
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REMOVERS):
+                    continue
+                base = dotted(node.func.value)
+                if not base.startswith("self."):
+                    continue
+                container = base.split(".")[-1]
+                live = container in _LIVE_CONTAINERS
+                queued = container in _QUEUED_CONTAINERS
+                if not live and not queued:
+                    continue
+                q = qualname_at(line_map, node.lineno)
+                root = gid(mod.relpath, q)
+                ok = _closure_has(pg, index, root, _has_release)
+                if not ok and queued:
+                    ok = _closure_has(pg, index, root, _has_promote)
+                if ok or mod.suppressed("LEAK001", node.lineno):
+                    continue
+                findings.append(Finding(
+                    "LEAK001", mod.relpath, node.lineno, q,
+                    f"sequence removed from self.{container} but no allocator "
+                    f"release/free is reachable from {q}() — blocks leak on "
+                    f"this exit path",
+                    key=f"no-release:{container}",
+                ))
+    return findings

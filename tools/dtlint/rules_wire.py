@@ -1,0 +1,370 @@
+"""WIRE001 — cross-process request-wire drift.
+
+The frontend process (preprocessor/router) and the worker process
+(engine/mocker) agree on the request wire only by convention: a plain dict
+whose keys are string literals on both sides, with no shared schema object
+crossing the process boundary (``PreprocessedRequest.to_wire`` is the
+closest thing to one, but half the traffic mutates the dict after it).
+Nothing catches a renamed or dropped key until a request silently loses its
+sampling params in production. This rule diffs the two sides statically:
+
+- **channel A (top-level request keys)**: every key a configured *reader*
+  consumes must be produced by some configured *writer* (a **ghost read**
+  returns the reader's ``.get`` default forever), and every key a writer
+  produces must be consumed by some reader (a **dead write** is either dead
+  code or a misspelled key whose real reader is starving).
+- **channel B (stop_conditions sub-keys)**: same two-directional check for
+  the nested ``stop_conditions`` dict, whose writer
+  (``stop_conditions_from_request``) and reader (``StopConditions.from_dict``)
+  live three hops apart. Chained reads like
+  ``(req.get("stop_conditions") or {}).get("stop")`` and mutations through
+  ``stop``-named locals are routed here, not to channel A.
+- **channel C (mocker stats parity)**: every stats family the mocker's
+  emitters publish must exist on the real engine plane (literal match or an
+  engine f-string wildcard) — the planner/observer tunes against the mocker,
+  so a mocker-only family calibrates against a metric production never has.
+
+Scopes are *function*-qualified (``path::qualname``), not file-level:
+receiver names collide across protocol layers — the preprocessor's
+``request`` parameter is the OpenAI body in ``transform_request`` but the
+wire dict in ``transform_response`` — so only per-function roles keep the
+OpenAI-body keys out of the wire universe. Within a configured function,
+only request-shaped receivers count (the dict-typed first parameter,
+``*req*``/``wire`` names, and — for writers — locals that are returned),
+which keeps sub-dicts like ``sampling_options`` lookups out of channel A.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dtlint.core import (
+    Finding, ProjectIndex, dotted, iter_functions, rule,
+)
+from tools.dtlint.rules_metrics import _dataclass_fields, _fstring_pattern
+
+# (channel, key) -> [(file, line, qualname)]
+Sites = Dict[Tuple[str, str], List[Tuple[str, int, str]]]
+
+CH_TOP = "request"
+CH_STOP = "stop_conditions"
+
+
+def _match_scope(relpath: str, entries: Tuple[str, ...]) -> List[str]:
+    """Qualnames configured for this file (entries are 'path::qualname')."""
+    out = []
+    for e in entries:
+        path, _, q = e.partition("::")
+        if relpath == path or relpath.endswith("/" + path):
+            out.append(q)
+    return out
+
+
+def _functions_for(index: ProjectIndex, entries: Tuple[str, ...]):
+    """Yield (mod, qualname, fn_node, stop_tagged=False) for configured
+    functions. Walking the node covers nested defs (transform_response's
+    inner ``gen()`` reads count for the outer entry)."""
+    for mod in index.modules:
+        quals = _match_scope(mod.relpath, entries)
+        if not quals:
+            continue
+        for q, fn in iter_functions(mod.tree):
+            if q in quals:
+                yield mod, q, fn
+
+
+def _first_param(fn: ast.AST) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    names = [a.arg for a in args.args if a.arg not in ("self", "cls")]
+    return names[0] if names else None
+
+
+def _route(recv: ast.AST, allow: Set[str]) -> Optional[str]:
+    """Which channel a receiver belongs to: CH_STOP for stop-named locals
+    and ``(... .get("stop_conditions") ...)`` chains, CH_TOP for
+    request-shaped names, None (ignored) otherwise."""
+    name = dotted(recv)
+    if name:
+        tail = name.split(".")[-1]
+        if "stop" in tail:
+            return CH_STOP
+        if "req" in tail or tail in ("wire", "frame") or tail in allow:
+            return CH_TOP
+        return None
+    try:
+        src = ast.unparse(recv)
+    except Exception:  # pragma: no cover - malformed receiver
+        return None
+    return CH_STOP if '"stop_conditions"' in src or "'stop_conditions'" in src else None
+
+
+def _const_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _returned_names(fn: ast.AST) -> Set[str]:
+    """Locals that leave the function as a wire payload: returned or
+    yielded (engine output frames are yielded dicts, not returned ones)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            out.add(node.value.id)
+        elif isinstance(node, ast.Yield) and isinstance(node.value, ast.Name):
+            out.add(node.value.id)
+    return out
+
+
+def _note(sites: Sites, ch: str, key: str, mod, line: int, q: str) -> None:
+    sites.setdefault((ch, key), []).append((mod.relpath, line, q))
+
+
+def _collect_writes(index: ProjectIndex, sites: Sites) -> None:
+    cfg = index.config
+    for mod, q, fn in _functions_for(index, cfg.wire_writers):
+        allow = _returned_names(fn)
+        p = _first_param(fn)
+        if p:
+            allow.add(p)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Subscript) and _const_key(tgt.slice)):
+                        continue
+                    key = _const_key(tgt.slice)
+                    ch = _route(tgt.value, allow)
+                    if ch is None:
+                        continue
+                    _note(sites, ch, key, mod, tgt.lineno, q)
+                    # A dict literal stored under "stop_conditions" writes
+                    # its own keys onto channel B (disagg's max_tokens=1).
+                    if ch == CH_TOP and key == CH_STOP and isinstance(node.value, ast.Dict):
+                        for k in node.value.keys:
+                            sub = _const_key(k) if k is not None else None
+                            if sub:
+                                _note(sites, CH_STOP, sub, mod, k.lineno, q)
+            elif isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    key = _const_key(k) if k is not None else None
+                    if key:
+                        _note(sites, CH_TOP, key, mod, k.lineno, q)
+        # Dict literals assigned to a wire-shaped local: ``d = {...};
+        # return d`` (to_wire) or ``frame = {...}`` later yielded/queued —
+        # the literal's top-level keys are wire writes.
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _route(node.targets[0], allow) == CH_TOP
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    key = _const_key(k) if k is not None else None
+                    if key:
+                        _note(sites, CH_TOP, key, mod, k.lineno, q)
+    # Stop-channel writers: every literal dict they return is the
+    # stop_conditions payload itself.
+    for mod, q, fn in _functions_for(index, cfg.wire_stop_writers):
+        ret = _returned_names(fn)
+        for node in ast.walk(fn):
+            d = None
+            if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                d = node.value
+            elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id in ret
+                    and isinstance(node.value, ast.Dict)):
+                d = node.value
+            elif (isinstance(node, ast.Assign) and isinstance(node.targets[0], ast.Subscript)
+                    and _const_key(node.targets[0].slice)):
+                _note(sites, CH_STOP, _const_key(node.targets[0].slice), mod,
+                      node.lineno, q)
+            if d is not None:
+                for k in d.keys:
+                    key = _const_key(k) if k is not None else None
+                    if key:
+                        _note(sites, CH_STOP, key, mod, k.lineno, q)
+
+
+def _collect_reads(index: ProjectIndex, sites: Sites) -> None:
+    cfg = index.config
+
+    def scan(mod, q, fn, force_stop: bool) -> None:
+        allow: Set[str] = set()
+        p = _first_param(fn)
+        if p:
+            allow.add(p)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("get", "pop") and node.args):
+                key = _const_key(node.args[0])
+                if key is None:
+                    continue
+                ch = CH_STOP if force_stop else _route(node.func.value, allow)
+                if ch is not None:
+                    _note(sites, ch, key, mod, node.lineno, q)
+            elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+                key = _const_key(node.slice)
+                if key is None:
+                    continue
+                ch = CH_STOP if force_stop else _route(node.value, allow)
+                if ch is not None:
+                    _note(sites, ch, key, mod, node.lineno, q)
+
+    for mod, q, fn in _functions_for(index, cfg.wire_readers):
+        scan(mod, q, fn, force_stop=False)
+    for mod, q, fn in _functions_for(index, cfg.wire_stop_readers):
+        scan(mod, q, fn, force_stop=True)
+
+
+def _stats_keys_for(mod, cfg) -> Tuple[Set[str], List[str]]:
+    """(literal stats families, f-string wildcard patterns) a module's
+    emitter functions publish — the per-module slice of MET001's
+    collect_wire_keys, for engine/mocker parity."""
+    literals: Set[str] = set()
+    wildcards: List[str] = []
+    for q, fn in iter_functions(mod.tree):
+        if q.split(".")[-1] not in cfg.met001_emitters:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if k is None:
+                        continue
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        literals.add(k.value)
+                    elif isinstance(k, ast.JoinedStr):
+                        wildcards.append(_fstring_pattern(k))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        key = _const_key(tgt.slice)
+                        if key:
+                            literals.add(key)
+                        elif isinstance(tgt.slice, ast.JoinedStr):
+                            wildcards.append(_fstring_pattern(tgt.slice))
+            elif isinstance(node, ast.Call) and "self.__dict__" in ast.unparse(node):
+                if "." in q:
+                    cls = q.rsplit(".", 2)[-2]
+                    literals.update(n for n, _ in _dataclass_fields(mod.tree, cls))
+    return literals, wildcards
+
+
+def _mocker_parity(index: ProjectIndex) -> List[Finding]:
+    cfg = index.config
+    engine_lits: Set[str] = set()
+    engine_wild: List[str] = []
+    mocker_mod = None
+    for mod in index.modules:
+        if mod.relpath == cfg.mocker_path or mod.relpath.endswith("/" + cfg.mocker_path):
+            mocker_mod = mod
+            continue
+        if any(x in mod.relpath for x in cfg.met001_exclude):
+            continue
+        lits, wild = _stats_keys_for(mod, cfg)
+        engine_lits |= lits
+        engine_wild.extend(wild)
+    # The aggregator's declared key lists ARE the engine plane's contract —
+    # a mocker family the aggregator already fleet-sums is real parity even
+    # if no engine emitter spells it as a literal in an emitter function.
+    agg = index.module(cfg.aggregator_path)
+    if agg is not None:
+        from tools.dtlint.rules_metrics import _key_list_lines
+
+        for lname in ("COUNTER_KEYS", "GAUGE_KEYS", "DIGEST_KEYS"):
+            engine_lits |= set(_key_list_lines(agg.tree, lname))
+    if mocker_mod is None:
+        return []
+    patterns = [re.compile(w) for w in engine_wild]
+    findings: List[Finding] = []
+    lits, _ = _stats_keys_for(mocker_mod, cfg)
+    # Re-walk for line attribution (sets lose it).
+    for q, fn in iter_functions(mocker_mod.tree):
+        if q.split(".")[-1] not in cfg.met001_emitters:
+            continue
+        for node in ast.walk(fn):
+            keys: List[Tuple[str, int]] = []
+            if isinstance(node, ast.Dict):
+                keys = [(k.value, k.lineno) for k in node.keys
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+            elif isinstance(node, ast.Assign):
+                keys = [(_const_key(t.slice), t.lineno) for t in node.targets
+                        if isinstance(t, ast.Subscript) and _const_key(t.slice)]
+            for key, line in keys:
+                if key in engine_lits or any(p.fullmatch(key) for p in patterns):
+                    continue
+                if mocker_mod.suppressed("WIRE001", line):
+                    continue
+                findings.append(Finding(
+                    "WIRE001", mocker_mod.relpath, line, q,
+                    f"mocker stats family '{key}' has no counterpart on the "
+                    f"real engine plane — planner calibration against the "
+                    f"mocker would tune on a metric production never emits",
+                    key=f"mocker-stats:{key}",
+                ))
+    return findings
+
+
+@rule("WIRE001", "cross-process wire drift: ghost reads, dead writes, stop_conditions sub-key drift, mocker stats parity")
+def wire001(index: ProjectIndex) -> List[Finding]:
+    writes: Sites = {}
+    reads: Sites = {}
+    _collect_writes(index, writes)
+    _collect_reads(index, reads)
+
+    findings: List[Finding] = []
+    written = {k for k in writes}
+    read = {k for k in reads}
+
+    for (ch, key), sites in sorted(reads.items()):
+        if (ch, key) in written:
+            continue
+        relpath, line, q = sites[0]
+        mod = index.module(relpath)
+        if mod is not None and mod.suppressed("WIRE001", line):
+            continue
+        where = "request" if ch == CH_TOP else "stop_conditions"
+        findings.append(Finding(
+            "WIRE001", relpath, line, q,
+            f"ghost read: {where} key '{key}' is read here but no configured "
+            f"wire writer ever produces it — the .get() default is the only "
+            f"value this branch will ever see",
+            key=f"ghost-read:{ch}:{key}",
+        ))
+    for (ch, key), sites in sorted(writes.items()):
+        if (ch, key) in read:
+            continue
+        relpath, line, q = sites[0]
+        mod = index.module(relpath)
+        if mod is not None and mod.suppressed("WIRE001", line):
+            continue
+        where = "request" if ch == CH_TOP else "stop_conditions"
+        findings.append(Finding(
+            "WIRE001", relpath, line, q,
+            f"dead write: {where} key '{key}' is written here but no "
+            f"configured wire reader ever consumes it — dead code, or a "
+            f"misspelling whose real reader is starving",
+            key=f"dead-write:{ch}:{key}",
+        ))
+
+    findings.extend(_mocker_parity(index))
+    return findings
+
+
+def wire_universe(index: ProjectIndex) -> Dict[str, Dict[str, List[Tuple[str, int, str]]]]:
+    """Debug/test export: the extracted wire key universe per channel."""
+    writes: Sites = {}
+    reads: Sites = {}
+    _collect_writes(index, writes)
+    _collect_reads(index, reads)
+    out: Dict[str, Dict[str, List[Tuple[str, int, str]]]] = {
+        "writes": {}, "reads": {},
+    }
+    for (ch, key), sites in writes.items():
+        out["writes"][f"{ch}:{key}"] = sites
+    for (ch, key), sites in reads.items():
+        out["reads"][f"{ch}:{key}"] = sites
+    return out
