@@ -1362,6 +1362,24 @@ def bench_autoscale():
     return report
 
 
+def bench_elastic():
+    """Elastic prefill/decode: degrade-vs-queue TTFT/goodput curves under a
+    shifting ISL/OSL mix (tools/traffic_harness.py run_elastic_bench). Three
+    fleets of identical hardware — pure disagg (static split, queues on
+    saturation), pure co-located (mixed everywhere, constant interference),
+    elastic (disagg + capacity dial + degradation ladder) — offered the same
+    seeded mix flip. CI asserts the elastic fleet strictly dominates both
+    static extremes on SLO attainment AND goodput, with zero token loss and
+    both degrade directions exercised."""
+    import asyncio
+
+    from tools.traffic_harness import ElasticBenchConfig, run_elastic_bench
+
+    cfg = ElasticBenchConfig()
+    cfg.pattern.duration_s = float(os.environ.get("BENCH_ELASTIC_S", "16"))
+    return asyncio.run(run_elastic_bench(cfg))
+
+
 # --------------------------------------------------------------------------
 # child: run sections against the already-chosen backend, emit partials
 # --------------------------------------------------------------------------
@@ -1803,6 +1821,25 @@ def child_main() -> None:
     else:
         errors.append("autoscale skipped: budget")
 
+    # --- elastic prefill/decode (degrade-vs-queue, CPU subprocess) ----------
+    elastic = None
+    if remaining() > 60:
+        try:
+            elastic, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "summary",
+                max(60, remaining() - 10), extra_env={"BENCH_ELASTIC_ONLY": "1"},
+            )
+            if elastic is None:
+                errors.append(f"elastic: {err}")
+            else:
+                _emit_partial("elastic", elastic)
+        except subprocess.TimeoutExpired:
+            errors.append("elastic: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"elastic: {type(e).__name__}: {e}")
+    else:
+        errors.append("elastic skipped: budget")
+
     print(json.dumps(assemble(decode_points, prefill_detail, http, device, model,
                               cpu_fallback, errors, tpu_http=tpu_http,
                               router_prefix=router_prefix, large_model=large_detail,
@@ -1812,10 +1849,10 @@ def child_main() -> None:
                               decode_overlap=decode_overlap,
                               prefix_reuse=prefix_reuse,
                               decode_attention=decode_attention,
-                              autoscale=autoscale)), flush=True)
+                              autoscale=autoscale, elastic=elastic)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None, autoscale=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None, autoscale=None, elastic=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -1847,6 +1884,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
             "guided_overhead": guided_overhead,
             "decode_overlap": decode_overlap,
             "autoscale": autoscale,
+            "elastic": elastic,
             "device": device,
             "cpu_fallback": cpu_fallback,
             "errors": errors,
@@ -2026,6 +2064,13 @@ if __name__ == "__main__":
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_autoscale()), flush=True)
+    elif os.environ.get("BENCH_ELASTIC_ONLY") == "1":
+        # CPU-pinned: the subject is topology policy (dial + degradation
+        # ladder vs static extremes) over mocker fleets, not a device.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_elastic()), flush=True)
     elif os.environ.get("BENCH_OBS_ONLY") == "1":
         # CPU-pinned: measures the tracing layer's host-side cost, which a
         # device tunnel's dispatch latency would drown out.

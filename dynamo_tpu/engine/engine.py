@@ -309,12 +309,14 @@ class TpuEngine:
         sampling_d = request.get("sampling_options") or {}
         temp = sampling_d.get("temperature")
         seed = sampling_d.get("seed")
+        tlp = int(sampling_d.get("top_logprobs") or 0)
         sampling = SamplingParams(
             temperature=1.0 if temp is None else float(temp),  # null ≡ unset ≡ default
             top_k=int(sampling_d.get("top_k") or 0),
             top_p=float(sampling_d.get("top_p") or 1.0),
             seed=int(seed) if seed is not None else None,
-            logprobs=bool(sampling_d.get("logprobs")),
+            logprobs=bool(sampling_d.get("logprobs")) or tlp > 0,
+            top_logprobs=tlp,
             frequency_penalty=float(sampling_d.get("frequency_penalty") or 0.0),
             presence_penalty=float(sampling_d.get("presence_penalty") or 0.0),
         )
@@ -401,11 +403,14 @@ class TpuEngine:
 
                 frame = {"token_ids": [], "finish_reason": None, "index": 0}
                 logprobs = []
+                top_logprobs = []
                 for out in outs:
                     if out.finish_reason and out.finish_reason.startswith("error:"):
                         if frame["token_ids"]:
                             if logprobs:
                                 frame["logprobs"] = logprobs
+                            if top_logprobs:
+                                frame["top_logprobs"] = top_logprobs
                             yield frame  # tokens decoded before the error
                         finished = True
                         raise RuntimeError(out.finish_reason[6:])
@@ -413,6 +418,11 @@ class TpuEngine:
                         frame["token_ids"].append(out.token_id)
                     if out.logprob is not None:
                         logprobs.append(out.logprob)
+                    if out.top_logprobs is not None:
+                        # Per emitted token: [[alt_token_id, logprob], ...] —
+                        # parallel to frame["logprobs"] (top_logprobs implies
+                        # logprobs, so the lists stay index-aligned).
+                        top_logprobs.append([[t, lp] for t, lp in out.top_logprobs])
                     if out.queue_s is not None and "queue_s" not in frame:
                         frame["queue_s"] = out.queue_s
                     if out.cached_tokens is not None and "cached_tokens" not in frame:
@@ -424,6 +434,8 @@ class TpuEngine:
                         frame["finish_reason"] = out.finish_reason
                 if logprobs:
                     frame["logprobs"] = logprobs
+                if top_logprobs:
+                    frame["top_logprobs"] = top_logprobs
                 yield frame
                 if frame["finish_reason"]:
                     finished = True
@@ -449,6 +461,15 @@ class TpuEngine:
         """Device-native export: stacked device arrays, no host round-trip.
         Returns ((k_stack, v_stack), hashes, prompt_len) or None."""
         return await asyncio.to_thread(self.scheduler.take_export_device, request_id)
+
+    # --- elastic capacity dial ---------------------------------------------
+    def set_capacity_dial(self, prefill_fraction: float) -> dict:
+        """Re-split this worker's budget between prefill and decode, live.
+
+        Thread-safe (scheduler takes _aux_lock); reachable remotely via the
+        ``set_dial`` control op on the worker's control subject.
+        """
+        return self.scheduler.set_capacity_dial(prefill_fraction)
 
     # --- introspection ------------------------------------------------------
     def metrics(self) -> ForwardPassMetrics:
@@ -491,6 +512,13 @@ class TpuEngine:
             "queue_wait_seconds_total": round(self.scheduler.queue_wait_s_total, 6),
             "prefill_wait_seconds_total": round(self.scheduler.prefill_wait_s_total, 6),
             "first_tokens_total": self.scheduler.first_tokens_total,
+            # Elastic capacity dial: the live prefill:decode budget split
+            # (set_capacity_dial) so the planner's ratio actuator and the
+            # Grafana "Elastic" row can see each worker's current shape.
+            "elastic_prefill_fraction": m.elastic_prefill_fraction,
+            "elastic_prefill_budget": m.elastic_prefill_budget,
+            "elastic_decode_slots": m.elastic_decode_slots,
+            "elastic_dial_changes_total": m.elastic_dial_changes_total,
         }
         # Flight recorder: per-phase step/token counters + the XLA compile
         # tracker (compiles_after_warmup_total > 0 in steady state is the

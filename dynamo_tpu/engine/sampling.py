@@ -33,6 +33,11 @@ class SamplingParams:
     presence_penalty: float = 0.0
     # Return the chosen token's log-probability with each step.
     logprobs: bool = False
+    # Number of top-alternative (token, logprob) pairs to return per step
+    # (OpenAI ``top_logprobs``). Served from the same fused sampling
+    # dispatch with a STATIC candidate cap (TOP_LOGPROBS_CAP) so every
+    # request shares one executable; implies ``logprobs``.
+    top_logprobs: int = 0
     # Per-request processors (dynamo_tpu.logits_processing) — host path.
     logits_processors: List = field(default_factory=list)
 
@@ -275,3 +280,56 @@ def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Log-probability of chosen tokens. logits [B, V], tokens [B] → [B]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]
+
+
+# Static per-executable candidate count for top-logprobs rows. Requests ask
+# for k ∈ [1, TOP_LOGPROBS_CAP] (the OpenAI bound is 20) but the dispatch
+# always computes the cap: a traced k would compile one executable per
+# distinct requested k. Rows trim to their own k on the host.
+TOP_LOGPROBS_CAP = 20
+
+
+def compute_topk_logprobs(logits: jax.Array, tokens: jax.Array) -> tuple:
+    """Chosen-token logprob plus the TOP_LOGPROBS_CAP most likely tokens'
+    ids and logprobs in one op group — logits [B, V], tokens [B] →
+    (chosen [B] f32, top_ids [B, CAP] i32, top_lps [B, CAP] f32)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]
+    cap = min(TOP_LOGPROBS_CAP, logits.shape[-1])
+    top_lps, top_ids = jax.lax.top_k(logp, cap)
+    return chosen, top_ids.astype(jnp.int32), top_lps
+
+
+def sample_batch_top_logprobs(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    key: jax.Array,
+    row_keys: Optional[jax.Array] = None,
+) -> tuple:
+    """``sample_batch_logprobs`` widened with the per-row top-k alternatives
+    (OpenAI ``top_logprobs``) in the SAME dispatch → (tokens [B] i32,
+    logprobs [B] f32, top_ids [B, CAP] i32, top_lps [B, CAP] f32). One
+    executable regardless of each row's requested k (static cap)."""
+    tok = sample_batch(logits, temperature, top_k, top_p, key, row_keys)
+    chosen, top_ids, top_lps = compute_topk_logprobs(logits, tok)
+    return tok, chosen, top_ids, top_lps
+
+
+def guided_sample_batch_top_logprobs(
+    logits: jax.Array,
+    pool: jax.Array,
+    k_rows: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    key: jax.Array,
+    row_keys: Optional[jax.Array] = None,
+) -> tuple:
+    """``guided_sample_batch_logprobs`` + fused top-k alternatives. Like the
+    lp variant, all logprobs (chosen and alternatives) are of the MASKED
+    distribution — the renormalized probability over the FSM-allowed set."""
+    masked = apply_token_masks(logits, pool, k_rows[1])
+    tok = sample_batch(masked, temperature, k_rows[0], top_p, key, row_keys)
+    chosen, top_ids, top_lps = compute_topk_logprobs(masked, tok)
+    return tok, chosen, top_ids, top_lps

@@ -125,6 +125,10 @@ class StepOutput:
     # behind OpenAI ``usage.prompt_tokens_details.cached_tokens`` and the
     # KV router's reuse accounting.
     cached_tokens: Optional[int] = None
+    # OpenAI ``top_logprobs``: [(token_id, logprob), ...] for the k most
+    # likely tokens at this position (k = sampling.top_logprobs), computed
+    # in the same fused sampling dispatch as ``logprob``.
+    top_logprobs: Optional[list] = None
 
 
 @dataclass
@@ -171,6 +175,9 @@ class Sequence:
     # Chosen-token logprob computed by the single-row sampler, consumed by
     # the next _append_token (sampling.logprobs requests).
     _pending_logprob: Optional[float] = None
+    # Top-k alternatives for the same token (sampling.top_logprobs > 0),
+    # consumed alongside _pending_logprob.
+    _pending_top_logprobs: Optional[list] = None
     # Request tracing: (trace_id, parent_span_id) when this request's trace
     # is sampled; None keeps the scheduler's trace path one branch.
     trace: Optional[tuple] = None
@@ -323,6 +330,14 @@ class ForwardPassMetrics:
     prefix_miss_blocks_total: int = 0
     prefix_evicted_blocks_total: int = 0
     prefix_onboard_total: int = 0
+    # Elastic capacity dial (set_capacity_dial): the live prefill:decode
+    # split. fraction 0.5 = the configured budget/slots; the budget/slots
+    # gauges carry the APPLIED values so the router's cost model and the
+    # planner's ratio actuator see the dial, not just its setting.
+    elastic_prefill_fraction: float = 0.5
+    elastic_prefill_budget: int = 0
+    elastic_decode_slots: int = 0
+    elastic_dial_changes_total: int = 0
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()
@@ -455,6 +470,16 @@ class Scheduler:
         )
         self._moe_dropped_total = 0  # guarded-by: _aux_lock
         self._moe_assignments_total = 0  # guarded-by: _aux_lock
+        # Elastic capacity dial (set_capacity_dial): bases capture the
+        # CONFIGURED split — the dial scales mixed_prefill_budget and the
+        # admission slot cap around them, fraction 0.5 = identity. Written
+        # from the event loop (control op / planner actuator) while the
+        # step thread reads the live sc knobs and the stats scrape reads
+        # the gauges, so the grouped update rides _aux_lock.
+        self._base_mixed_prefill_budget = self.sc.mixed_prefill_budget or self.sc.max_prefill_chunk
+        self._base_max_running = self.sc.max_running
+        self._elastic_fraction = 0.5  # guarded-by: _aux_lock
+        self.elastic_dial_changes_total = 0  # guarded-by: _aux_lock
         self._pending_aux: list = []
         # _drain_aux runs on the step thread (overflow drain in
         # _consume_aux) AND the event loop (metrics()/moe_* properties via
@@ -496,11 +521,17 @@ class Scheduler:
         # round-trip per step for any batch with a logprobs row.
         from dynamo_tpu.engine.sampling import (
             guided_sample_batch_logprobs,
+            guided_sample_batch_top_logprobs,
             sample_batch_logprobs,
+            sample_batch_top_logprobs,
         )
 
         self._sample_lp_jit = jax.jit(sample_batch_logprobs)
         self._guided_sample_lp_jit = jax.jit(guided_sample_batch_logprobs)
+        # Top-k variants (OpenAI top_logprobs): chosen logprob + the static
+        # candidate cap's (ids, logprobs) in the same dispatch.
+        self._sample_tlp_jit = jax.jit(sample_batch_top_logprobs)
+        self._guided_sample_tlp_jit = jax.jit(guided_sample_batch_top_logprobs)
         # Zero-bubble overlapped decode (llama.decode_sample): fused
         # decode+sample+state-advance, device-side token feedback. _pipe
         # holds the in-flight step (see _overlap_step); _tables_cache keeps
@@ -831,6 +862,10 @@ class Scheduler:
             prefix_miss_blocks_total=a.miss_blocks_total,
             prefix_evicted_blocks_total=a.evicted_blocks_total,
             prefix_onboard_total=self.prefix_onboard_total,
+            elastic_prefill_fraction=self._elastic_fraction,
+            elastic_prefill_budget=self.sc.mixed_prefill_budget or 0,
+            elastic_decode_slots=self.sc.max_running,
+            elastic_dial_changes_total=self.elastic_dial_changes_total,
         )
 
     def kv_gauges(self) -> dict:
@@ -931,6 +966,62 @@ class Scheduler:
             },
             "parallel": str(self.parallel) if self.parallel is not None else None,
         }
+
+    # --- elastic capacity dial ----------------------------------------------
+    def set_capacity_dial(self, prefill_fraction: float) -> dict:
+        """Live prefill:decode capacity split — the worker half of elastic
+        prefill/decode (ROADMAP item 2; DynaServe arXiv:2504.09285 argues
+        the same continuous-ratio pool). ``prefill_fraction`` ∈ [0, 1]:
+
+        - 0.5 — the configured identity (mixed_prefill_budget / max_running
+          exactly as constructed);
+        - → 1.0 — prefill-heavy: the mixed-step chunk budget scales up to
+          2× (clamped to max_prefill_chunk) while decode admission slots
+          shrink toward 1;
+        - → 0.0 — decode-heavy: admission slots stay at the configured cap
+          while the chunk budget shrinks toward one block.
+
+        Slots never exceed the configured max_running (the allocator and
+        decode buckets are sized for it), and already-admitted rows past a
+        shrunken cap drain naturally (_decode_step slices by decode bucket,
+        not max_running). Thread-safe: called from the event loop (control
+        op / planner actuator) while the step thread reads the knobs — the
+        grouped update rides _aux_lock so a stats scrape never observes a
+        half-applied dial. Returns the applied values."""
+        f = min(1.0, max(0.0, float(prefill_fraction)))
+        raw = int(round(2.0 * f * self._base_mixed_prefill_budget))
+        budget = max(self.mc.block_size, min(raw, self.sc.max_prefill_chunk))
+        slots = int(round(2.0 * (1.0 - f) * self._base_max_running))
+        slots = max(1, min(self._base_max_running, slots))
+        with self._aux_lock:
+            self._elastic_fraction = f
+            self.sc.mixed_prefill_budget = budget
+            self.sc.max_running = slots
+            self.elastic_dial_changes_total += 1
+        logger.info(
+            "capacity dial: prefill_fraction=%.3f → mixed_prefill_budget=%d decode_slots=%d",
+            f, budget, slots,
+        )
+        return {
+            "prefill_fraction": f,
+            "mixed_prefill_budget": budget,
+            "decode_slots": slots,
+        }
+
+    def _mixed_warm_buckets(self) -> List[int]:
+        """Prefill-chunk buckets a mixed step can ride across the capacity
+        dial's whole range: raw budgets span [block_size, min(2·base,
+        max_prefill_chunk)] and chunks bucket UP (next_bucket), so warmup
+        must cover every bucket between those bounds — a ratio shift must
+        never compile mid-traffic (WARM001 / flight-recorder gate)."""
+        eligible = [b for b in self.sc.prefill_buckets if b <= self.sc.max_prefill_chunk]
+        if not eligible:
+            eligible = [self.sc.prefill_buckets[0]]
+        lo = next_bucket(max(self.mc.block_size, 1), eligible)
+        hi = next_bucket(
+            min(2 * self._base_mixed_prefill_budget, self.sc.max_prefill_chunk), eligible
+        )
+        return [b for b in eligible if lo <= b <= hi] or [eligible[0]]
 
     # --- step loop core (runs in worker thread) -----------------------------
     def step(self) -> List[tuple]:
@@ -1074,8 +1165,9 @@ class Scheduler:
         p_table = self._prefill_table(seq)
         has_prefix = seq.num_computed > 0
 
-        # Decode batch formation — identical to _decode_step.
-        n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
+        # Decode batch formation — identical to _decode_step (see there for
+        # why max_running is NOT a term: dial shrinks must not strand rows).
+        n = min(len(self.running), self.sc.decode_buckets[-1])
         batch = self.running[:n]
         d_bucket = next_bucket(n, self.sc.decode_buckets)
         width = self._width_bucket(max(len(s.block_ids) for s in batch))
@@ -1238,6 +1330,7 @@ class Scheduler:
             and seq.mm_features is None
             and seq.guided is None  # wave samples on device, unmasked
             and not s.logprobs
+            and not s.top_logprobs
             and not s.logits_processors
             and not (s.seed is not None and s.temperature > 0)
         )
@@ -1614,7 +1707,14 @@ class Scheduler:
                 jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
                 jnp.ones((bucket,), jnp.float32), key, None,
             )
-            count += 2
+            # ... and the top-k variant (OpenAI top_logprobs; static
+            # candidate cap, so one warm covers every requested k).
+            self._sample_tlp_jit(
+                jnp.zeros((bucket, self.mc.vocab_size), jnp.float32),
+                jnp.zeros((bucket,), jnp.float32), jnp.zeros((bucket,), jnp.int32),
+                jnp.ones((bucket,), jnp.float32), key, None,
+            )
+            count += 3
         # Deferred-retirement KV rollback (overlap pipeline): one executable,
         # warmed against the scratch slot so a finish-mid-pipeline never
         # compiles under traffic.
@@ -1653,7 +1753,13 @@ class Scheduler:
                     jnp.zeros((bucket,), jnp.float32),
                     jnp.ones((bucket,), jnp.float32), key, None,
                 )
-                count += 2
+                self._guided_sample_tlp_jit(
+                    jnp.zeros((bucket, self.mc.vocab_size), jnp.float32), pool,
+                    jnp.zeros((2, bucket), jnp.int32),
+                    jnp.zeros((bucket,), jnp.float32),
+                    jnp.ones((bucket,), jnp.float32), key, None,
+                )
+                count += 3
         prev_bucket = 0
         for bucket in self.sc.prefill_buckets:
             if bucket > self.sc.max_prefill_chunk:
@@ -1740,39 +1846,36 @@ class Scheduler:
                             )
                         )
                         count += 1
-        # Mixed prefill+decode executables: the common (decode_bucket,
-        # prefill_bucket) shapes — the budget-sized chunk bucket (what a
-        # long prompt rides each step) at every decode bucket × width,
-        # with the minimum prefill-table width. Bucket rungs keep the key
-        # space bounded; rarer (s, Wp) keys compile lazily.
+        # Mixed prefill+decode executables: every budget-sized chunk bucket
+        # the capacity dial can produce (_mixed_warm_buckets — a ratio
+        # shift between dial settings must not compile mid-traffic) at
+        # every decode bucket × width, with the minimum prefill-table
+        # width. Bucket rungs keep the key space bounded; rarer (s, Wp)
+        # keys compile lazily.
         if (
             self._supports_mixed
             and self.sc.enable_mixed_batching
             and self.draft_params is None
         ):
-            s_b = next_bucket(
-                min(self.sc.mixed_prefill_budget or self.sc.max_prefill_chunk,
-                    self.sc.max_prefill_chunk),
-                self.sc.prefill_buckets,
-            )
             p_w = max(16, width_bucket(1, self.max_blocks_per_seq))
-            for bucket in self.sc.decode_buckets:
-                for width in widths:
-                    self.flight.record_exec(
-                        "mixed",
-                        (s_b, p_w, bucket, width)
-                        + ((False,) if self._use_flash_prefill else ()),
-                    )
-                    res = self._get_mixed_jit((s_b, p_w, bucket, width))(
-                        self.params, self.cache.k, self.cache.v,
-                        jnp.zeros((s_b,), jnp.int32), jnp.int32(1), jnp.int32(0),
-                        jnp.zeros((p_w,), jnp.int32), jnp.zeros((bucket,), jnp.int32),
-                        jnp.zeros((bucket,), jnp.int32),
-                        jnp.zeros((bucket, width), jnp.int32),
-                        jnp.zeros((bucket,), bool), False,
-                    )
-                    _, self.cache.k, self.cache.v = self._consume_aux(res)
-                    count += 1
+            for s_b in self._mixed_warm_buckets():
+                for bucket in self.sc.decode_buckets:
+                    for width in widths:
+                        self.flight.record_exec(
+                            "mixed",
+                            (s_b, p_w, bucket, width)
+                            + ((False,) if self._use_flash_prefill else ()),
+                        )
+                        res = self._get_mixed_jit((s_b, p_w, bucket, width))(
+                            self.params, self.cache.k, self.cache.v,
+                            jnp.zeros((s_b,), jnp.int32), jnp.int32(1), jnp.int32(0),
+                            jnp.zeros((p_w,), jnp.int32), jnp.zeros((bucket,), jnp.int32),
+                            jnp.zeros((bucket,), jnp.int32),
+                            jnp.zeros((bucket, width), jnp.int32),
+                            jnp.zeros((bucket,), bool), False,
+                        )
+                        _, self.cache.k, self.cache.v = self._consume_aux(res)
+                        count += 1
         # Speculative-round executables (draft chunk+sample, γ-1 proposal
         # window, target chunk scoring, rejection verify): _decode_spec keys
         # them by (γ, decode bucket, table width), so with a draft attached
@@ -1906,6 +2009,7 @@ class Scheduler:
             seq.aborted
             or seq.guided is not None
             or s.logprobs
+            or s.top_logprobs
             or s.logits_processors
             or s.has_penalties
             or (s.seed is not None and s.temperature > 0)
@@ -2063,7 +2167,12 @@ class Scheduler:
 
     def _decode_step(self) -> List[tuple]:
         outputs: List[tuple] = []
-        n = min(len(self.running), self.sc.max_running, self.sc.decode_buckets[-1])
+        # Batch size caps at the largest decode bucket — NOT max_running:
+        # admission keeps len(running) ≤ max_running in steady state, but a
+        # capacity-dial shrink can leave more rows running than the new
+        # cap, and slicing to max_running would decode the same head rows
+        # every step while the tail starved forever. Over-cap rows drain.
+        n = min(len(self.running), self.sc.decode_buckets[-1])
         batch = self.running[:n]
         bucket = next_bucket(n, self.sc.decode_buckets)
 
@@ -2072,6 +2181,7 @@ class Scheduler:
             and not any(
                 seq.sampling.logits_processors
                 or seq.sampling.logprobs
+                or seq.sampling.top_logprobs
                 or seq.sampling.has_penalties
                 or seq.mm_features is not None
                 # Guided rows can't ride speculation (proposal sampling
@@ -2093,6 +2203,7 @@ class Scheduler:
             and not any(
                 seq.sampling.logits_processors
                 or seq.sampling.logprobs
+                or seq.sampling.top_logprobs
                 or seq.sampling.has_penalties  # history changes within the window
                 # FSM state advances host-side per token — windows would
                 # sample N tokens device-side without mask updates.
@@ -2192,9 +2303,13 @@ class Scheduler:
         # Logprobs fold into the SAME sampling dispatch when any row wants
         # them (sampling.sample_batch_logprobs): one executable, one
         # readback — previously a separate compute_logprobs device op plus
-        # its own sync per step.
-        want_lp = any(seq.sampling.logprobs for seq in batch)
+        # its own sync per step. A top_logprobs row widens the dispatch to
+        # the top-k variant (static candidate cap — one executable for any
+        # requested k); the chosen-token logprob rides along either way.
+        want_tlp = any(seq.sampling.top_logprobs for seq in batch)
+        want_lp = want_tlp or any(seq.sampling.logprobs for seq in batch)
         logprobs_np = None
+        top_ids_np = top_lps_np = None
         if any(seq.guided is not None for seq in batch):
             # Guided rows: gather each row's FSM-state mask from the shared
             # device pool inside the fused mask+sample dispatch. Unguided
@@ -2207,7 +2322,14 @@ class Scheduler:
                 if seq.guided is not None:
                     k_rows[1, i] = seq.guided.row_id
             self.flight.record_exec("guided_sample", (bucket, int(pool.shape[0])))
-            if want_lp:
+            if want_tlp:
+                sampled, logprobs_np, top_ids_np, top_lps_np = jax.device_get(
+                    self._guided_sample_tlp_jit(
+                        logits, pool, jnp.asarray(k_rows),
+                        jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
+                    )
+                )
+            elif want_lp:
                 sampled, logprobs_np = jax.device_get(
                     self._guided_sample_lp_jit(
                         logits, pool, jnp.asarray(k_rows),
@@ -2221,6 +2343,12 @@ class Scheduler:
                         jnp.asarray(temps), jnp.asarray(top_ps), key, row_keys,
                     )
                 )
+        elif want_tlp:
+            sampled, logprobs_np, top_ids_np, top_lps_np = jax.device_get(
+                self._sample_tlp_jit(
+                    logits, jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), key, row_keys
+                )
+            )
         elif want_lp:
             sampled, logprobs_np = jax.device_get(
                 self._sample_lp_jit(
@@ -2240,8 +2368,19 @@ class Scheduler:
             self._ensure_block_capacity(seq)
             if seq.state != SeqState.RUNNING:
                 continue  # itself preempted (no candidate to evict)
-            lp = float(logprobs_np[i]) if logprobs_np is not None and seq.sampling.logprobs else None
-            self._append_token(seq, int(sampled[i]), outputs, logprob=lp)
+            lp = (
+                float(logprobs_np[i])
+                if logprobs_np is not None
+                and (seq.sampling.logprobs or seq.sampling.top_logprobs)
+                else None
+            )
+            tlp = None
+            if top_ids_np is not None and seq.sampling.top_logprobs:
+                k = min(seq.sampling.top_logprobs, top_ids_np.shape[1])
+                tlp = [
+                    (int(top_ids_np[i, j]), float(top_lps_np[i, j])) for j in range(k)
+                ]
+            self._append_token(seq, int(sampled[i]), outputs, logprob=lp, top_logprobs=tlp)
 
     def _decode_multi(self, batch: List[Sequence], bucket: int, outputs: List[tuple]) -> bool:
         """Multi-step decode window: N steps in one dispatch, one host sync.
@@ -2519,6 +2658,11 @@ class Scheduler:
 
         bs = self.mc.block_size
         data = seq.prefilled
+        # Token-boundary splits (elastic disagg): ``prefill_len`` marks how
+        # many prompt tokens the transferred KV covers. Absent or >= the
+        # prompt, this is the classic full-prefill handoff.
+        n_pref = min(int(data.get("prefill_len") or len(seq.prompt)), len(seq.prompt))
+        full = n_pref >= len(seq.prompt)
         n_blocks = (len(seq.prompt) + 1 + bs - 1) // bs
         seq.block_ids = self.allocator.allocate(n_blocks)  # raises → retried next step
         if "device_blocks" in data:
@@ -2527,7 +2671,7 @@ class Scheduler:
         else:
             for bid, (k_np, v_np) in zip(seq.block_ids, data["blocks"]):
                 scatter_blocks(self.cache, bid, k_np, v_np)
-        seq.num_computed = len(seq.prompt)
+        seq.num_computed = n_pref
         if seq.admitted_ts is None:
             seq.admitted_ts = time.monotonic()
         # Spec decode: the draft cache has nothing for remotely-prefilled KV —
@@ -2536,6 +2680,20 @@ class Scheduler:
         if self.sc.enable_prefix_caching:
             seq.block_hashes = extend_block_hashes([], seq.prompt, bs)
             self._register_full_blocks(seq)
+        if not full:
+            # Partial injection: the split request continues as a normal
+            # chunked prefill from position n_pref — the REAL first token
+            # is sampled at prompt completion (the prefill leg's capped
+            # first_token is a placeholder and is discarded), so the
+            # output stream is bit-identical to single-worker serving.
+            seq.state = SeqState.PREFILL
+            seq.prefilled = None
+            self._trace_event(
+                seq, "disagg_inject", blocks=len(seq.block_ids),
+                device_native="device_blocks" in data,
+                partial=True, prefill_len=n_pref,
+            )
+            return False
         seq.state = SeqState.RUNNING
         seq.first_token_ts = time.monotonic()
         self.running.append(seq)
@@ -2802,7 +2960,22 @@ class Scheduler:
                 self._row_key(seq),
             )
         token = int(np.asarray(tok)[0])
-        if s.logprobs:
+        if s.top_logprobs:
+            # First token's alternatives: same op group as the batched
+            # top-k path (guided rows already applied their mask above via
+            # the fused sampler; these logprobs are of the raw logits the
+            # single-row sampler saw).
+            from dynamo_tpu.engine.sampling import compute_topk_logprobs
+
+            chosen, ids, lps = jax.device_get(
+                compute_topk_logprobs(logits[None, :], jnp.asarray([token]))
+            )
+            seq._pending_logprob = float(chosen[0])
+            k = min(s.top_logprobs, ids.shape[1])
+            seq._pending_top_logprobs = [
+                (int(ids[0, j]), float(lps[0, j])) for j in range(k)
+            ]
+        elif s.logprobs:
             from dynamo_tpu.engine.sampling import compute_logprobs
 
             seq._pending_logprob = float(
@@ -2811,11 +2984,19 @@ class Scheduler:
         return token
 
     def _append_token(
-        self, seq: Sequence, token: int, outputs: List[tuple], logprob: Optional[float] = None
+        self,
+        seq: Sequence,
+        token: int,
+        outputs: List[tuple],
+        logprob: Optional[float] = None,
+        top_logprobs: Optional[list] = None,
     ) -> None:
         if logprob is None:
             logprob = getattr(seq, "_pending_logprob", None)
             seq._pending_logprob = None
+        if top_logprobs is None:
+            top_logprobs = getattr(seq, "_pending_top_logprobs", None)
+            seq._pending_top_logprobs = None
         seq.output_ids.append(token)
         if seq.guided is not None:
             # Host-side FSM advance: one next-state table lookup on the
@@ -2846,13 +3027,14 @@ class Scheduler:
             # Token that triggered 'stop' is still emitted (backend strips).
             outputs.append(
                 (seq, StepOutput(token_id=token, finished=True, finish_reason=reason,
-                                 logprob=logprob, queue_s=queue_s, cached_tokens=cached))
+                                 logprob=logprob, queue_s=queue_s, cached_tokens=cached,
+                                 top_logprobs=top_logprobs))
             )
             self._finish(seq, reason, outputs, emit=False)
         else:
             outputs.append(
                 (seq, StepOutput(token_id=token, logprob=logprob, queue_s=queue_s,
-                                 cached_tokens=cached))
+                                 cached_tokens=cached, top_logprobs=top_logprobs))
             )
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[str]:

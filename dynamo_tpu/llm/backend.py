@@ -110,6 +110,7 @@ class Backend(Operator):
                 text=text or None,
                 finish_reason=finish,
                 logprobs=out.logprobs,
+                top_logprobs=out.top_logprobs,
                 index=out.index,
                 tool_calls=tool_calls,
                 reasoning=reasoning,
@@ -161,6 +162,7 @@ class Backend(Operator):
                             token_ids=out.token_ids,
                             text=emit_text,
                             logprobs=out.logprobs,
+                            top_logprobs=out.top_logprobs,
                             index=out.index,
                             reasoning=reasoning_delta,
                         ).to_wire()

@@ -406,6 +406,8 @@ class DisaggDecodeHandler:
         prefill_queue_name: str = PREFILL_QUEUE,
         queue_reply_timeout_s: float = 30.0,
         kv_transfer: str = "device",
+        pool_load_probe: Optional[Any] = None,
+        block_size: int = 16,
     ):
         if strategy not in ("decode_first", "prefill_first"):
             raise ValueError(f"unknown disagg strategy: {strategy}")
@@ -428,6 +430,19 @@ class DisaggDecodeHandler:
         self.prefill_queue_name = prefill_queue_name
         self.remote_prefills = 0
         self.local_prefills = 0
+        # Elastic degradation ladder: an optional load probe (sync or async
+        # callable returning {"prefill_saturated": bool, "local_saturated":
+        # bool, "max_prefill_tokens": int|None}) lets the handler degrade
+        # PROACTIVELY — a saturated prefill pool routes to the co-located
+        # mixed batch (and a saturated local engine offloads to the pool)
+        # instead of queueing. None ⇒ reactive-only (pre-elastic behavior).
+        self.pool_load_probe = pool_load_probe
+        self.block_size = max(1, int(block_size))
+        self.degrade_disagg_to_colocated_total = 0
+        self.degrade_colocated_to_disagg_total = 0
+        # Token-boundary splits: prefill leg truncated to N tokens on the
+        # pool, remainder prefilled on the decode worker (partial KV inject).
+        self.split_prefills_total = 0
         # prefill_first liveness: cached queue-worker presence + timeout
         # backoff, so a pool with zero pull workers doesn't cost every request
         # the full queue_reply_timeout_s of TTFT before local fallback.
@@ -435,6 +450,33 @@ class DisaggDecodeHandler:
         self._liveness_ttl_s = 2.0
         self._backoff_until = 0.0
         self.queue_backoff_s = 15.0
+
+    async def _pool_load(self) -> dict:
+        if self.pool_load_probe is None:
+            return {}
+        try:
+            res = self.pool_load_probe()
+            if asyncio.iscoroutine(res) or isinstance(res, asyncio.Future):
+                res = await res
+            return res or {}
+        except Exception:  # noqa: BLE001 — a broken probe must not fail serving
+            logger.exception("pool load probe failed; treating as no signal")
+            return {}
+
+    def _mode_transition(self, context: Context, direction: str, reason: str, **kw) -> None:
+        """Trace a degradation-ladder step (observable mode transitions are
+        part of the elastic contract — chaos asserts them, Grafana counts
+        the paired degrade_*_total counters)."""
+        tp = context.traceparent
+        if tp is None:
+            return
+        from dynamo_tpu.runtime.tracing import get_tracer
+
+        get_tracer().event(
+            "mode_transition", tp.trace_id, parent_id=tp.parent_id,
+            service="worker", request_id=context.id, direction=direction,
+            reason=reason, **kw,
+        )
 
     async def can_prefill_remote(self) -> bool:
         if self.strategy == "prefill_first":
@@ -485,6 +527,38 @@ class DisaggDecodeHandler:
             if self.disagg_router is not None
             else can_remote
         )
+        # Elastic degradation ladder (proactive rungs): the load probe can
+        # override the length rule in BOTH directions before any wire hop —
+        # a saturated prefill pool sends this request to the co-located
+        # mixed batch instead of queueing behind the pool; a saturated local
+        # engine offloads its prefill to an idle pool. Every flip is counted
+        # and traced so chaos/bench can assert the ladder, not infer it.
+        load = await self._pool_load()
+        split_at = 0
+        if remote and load.get("prefill_saturated"):
+            remote = False
+            self.degrade_disagg_to_colocated_total += 1
+            self._mode_transition(context, "disagg_to_colocated", "prefill_pool_saturated",
+                                  prompt_tokens=len(tokens))
+        elif not remote and can_remote and load.get("local_saturated"):
+            remote = True
+            self.degrade_colocated_to_disagg_total += 1
+            self._mode_transition(context, "colocated_to_disagg", "local_saturated",
+                                  prompt_tokens=len(tokens))
+        if remote:
+            # Token-boundary split: the pool takes only the first N tokens
+            # (request-pinned split_at, else the probe's remaining prefill
+            # headroom rounded down to a block boundary); the decode worker
+            # finishes the remainder via partial KV injection + chunked
+            # prefill. N ≥ block_size so the transferred KV is non-empty.
+            dp = request.get("disagg_params") or {}
+            split_at = int(dp.get("split_at") or 0)
+            cap = load.get("max_prefill_tokens")
+            if split_at <= 0 and cap is not None and 0 < int(cap) < len(tokens):
+                split_at = (int(cap) // self.block_size) * self.block_size
+            if split_at < self.block_size or split_at >= len(tokens):
+                split_at = 0
+
         if not remote:
             self.local_prefills += 1
             async for item in self.engine.generate(request, context):
@@ -492,8 +566,12 @@ class DisaggDecodeHandler:
             return
 
         self.remote_prefills += 1
+        leg_start = time.monotonic()
         # 1) Forward prefill (max_tokens=1, keep blocks) to the prefill pool.
         prefill_req = dict(request)
+        if split_at:
+            prefill_req["token_ids"] = tokens[:split_at]
+            self.split_prefills_total += 1
         prefill_req["stop_conditions"] = {**(request.get("stop_conditions") or {}), "max_tokens": 1, "ignore_eos": True}
         prefill_req["disagg_params"] = {"do_remote_decode": True}
         prefill_ctx = context.child()  # same request id crosses the wire
@@ -505,6 +583,7 @@ class DisaggDecodeHandler:
                 "disagg_hop", tp.trace_id, parent_id=tp.parent_id, service="worker",
                 request_id=context.id, prompt_tokens=len(tokens),
                 strategy=self.strategy, kv_transfer=self.kv_transfer,
+                split_at=split_at,
             )
 
         try:
@@ -526,6 +605,9 @@ class DisaggDecodeHandler:
             if self.strategy == "prefill_first" and "timed out" in str(e):
                 self._backoff_until = time.monotonic() + self.queue_backoff_s
             logger.warning("remote prefill failed (%s); running locally", e)
+            self.degrade_disagg_to_colocated_total += 1
+            self._mode_transition(context, "disagg_to_colocated", f"remote_prefill_failed:{e}",
+                                  prompt_tokens=len(tokens))
             self.local_prefills += 1
             async for item in self.engine.generate(request, context):
                 yield item
@@ -533,13 +615,36 @@ class DisaggDecodeHandler:
 
         # 3) Continue decode locally from the injected KV.
         local_req = dict(request)
+        prefilled = {"first_token": first_token}
         if blocks is not None:
-            local_req["_prefilled"] = {"first_token": first_token, "blocks": blocks}
+            prefilled["blocks"] = blocks
         else:
-            local_req["_prefilled"] = {"first_token": first_token, "device_blocks": device_blocks}
+            prefilled["device_blocks"] = device_blocks
+        if split_at:
+            # Partial leg: the scheduler resumes chunked prefill at split_at
+            # and samples its OWN first token there — the pool leg's token
+            # (sampled from a truncated prompt) is discarded by the injector.
+            prefilled["prefill_len"] = split_at
+        local_req["_prefilled"] = prefilled
+        # Deadline folding: deadline_ms is the REMAINING budget at arrival,
+        # and the decode leg re-arrives at its local engine after the prefill
+        # hop + KV pull — without folding, a split/remote request would be
+        # granted the hop time twice over a single-worker serve.
+        stop = dict(request.get("stop_conditions") or {})
+        if stop.get("deadline_ms"):
+            elapsed_ms = (time.monotonic() - leg_start) * 1000.0
+            stop["deadline_ms"] = max(1.0, float(stop["deadline_ms"]) - elapsed_ms)
+            local_req["stop_conditions"] = stop
         async for item in self.engine.generate(local_req, context):
             yield item
 
     def stats_handler(self) -> dict:
         base = self.engine.stats_handler() if hasattr(self.engine, "stats_handler") else {}
-        return {**base, "remote_prefills": self.remote_prefills, "local_prefills": self.local_prefills}
+        return {
+            **base,
+            "remote_prefills": self.remote_prefills,
+            "local_prefills": self.local_prefills,
+            "degrade_disagg_to_colocated_total": self.degrade_disagg_to_colocated_total,
+            "degrade_colocated_to_disagg_total": self.degrade_colocated_to_disagg_total,
+            "split_prefills_total": self.split_prefills_total,
+        }

@@ -738,6 +738,7 @@ class HttpService:
             n_tokens = 0
             finish_reason = "stop"
             logprobs: list = []
+            top_logprobs: list = []
             async for item in engine.generate(b, c):
                 if isinstance(item, Annotated) and item.is_annotation():
                     if item.event == "_metrics" and i == 0:
@@ -765,6 +766,12 @@ class HttpService:
                     tool_calls = out.tool_calls
                 if out.logprobs:
                     logprobs.extend(out.logprobs)
+                    # Keep alternatives index-aligned with the chosen-token
+                    # list even if a frame carried logprobs without tops.
+                    tops = out.top_logprobs or []
+                    top_logprobs.extend(tops[: len(out.logprobs)])
+                    while len(top_logprobs) < len(logprobs):
+                        top_logprobs.append(None)
                 n_tokens += len(out.token_ids)
                 tokens_box[i] = n_tokens
                 if out.finish_reason:
@@ -777,6 +784,7 @@ class HttpService:
                 "finish_reason": finish_reason,
                 "n_tokens": n_tokens,
                 "logprobs": logprobs,
+                "top_logprobs": top_logprobs if any(top_logprobs) else None,
             }
 
         # Children need UNIQUE ids: the engine keys sequences by context.id,
@@ -857,7 +865,8 @@ class HttpService:
             choices = [
                 oai.chat_choice(
                     r["index"], r["text"], r["finish_reason"], r["tool_calls"], r["reasoning"],
-                    logprobs=oai.chat_logprobs_content(None, r["logprobs"]) if r["logprobs"] else None,
+                    logprobs=oai.chat_logprobs_content(None, r["logprobs"], r["top_logprobs"])
+                    if r["logprobs"] else None,
                 )
                 for r in results
             ]
@@ -867,7 +876,9 @@ class HttpService:
         choices = [
             oai.completion_choice(
                 r["index"], r["text"], r["finish_reason"],
-                logprobs=oai.completion_logprobs_block([""] * len(r["logprobs"]), r["logprobs"])
+                logprobs=oai.completion_logprobs_block(
+                    [""] * len(r["logprobs"]), r["logprobs"], r["top_logprobs"]
+                )
                 if r["logprobs"] else None,
             )
             for r in results
@@ -953,9 +964,9 @@ class HttpService:
                     lp = None
                     if out.logprobs:
                         lp = (
-                            oai.chat_logprobs_content(text, out.logprobs)
+                            oai.chat_logprobs_content(text, out.logprobs, out.top_logprobs)
                             if kind == "chat"
-                            else oai.completion_logprobs_block([text], out.logprobs)
+                            else oai.completion_logprobs_block([text], out.logprobs, out.top_logprobs)
                         )
                     if kind == "chat":
                         await _sse(resp, oai.chat_chunk(rid, model, {"content": text}, logprobs=lp))
@@ -1096,9 +1107,9 @@ class HttpService:
                     lp = None
                     if out.logprobs:
                         lp = (
-                            oai.chat_logprobs_content(text, out.logprobs)
+                            oai.chat_logprobs_content(text, out.logprobs, out.top_logprobs)
                             if kind == "chat"
-                            else oai.completion_logprobs_block([text], out.logprobs)
+                            else oai.completion_logprobs_block([text], out.logprobs, out.top_logprobs)
                         )
                     if kind == "chat":
                         await _sse(resp, oai.chat_chunk(rid, model, {"content": text}, index=i, logprobs=lp))

@@ -121,6 +121,10 @@ class KvPushRouter:
         self.predicted_cached_tokens_total = 0
         self.cached_tokens_total = 0
         self.cached_tokens_by_worker: dict = {}
+        # Elastic capacity dial (gossiped ForwardPassMetrics): per-worker
+        # prefill fraction feeds the cost model so routing follows the
+        # fleet's live prefill:decode shape, not just its KV state.
+        self.elastic_fraction_by_worker: dict = {}
 
     @classmethod
     async def create(cls, client: Client, config: Optional[KvRouterConfig] = None) -> "KvPushRouter":
@@ -152,7 +156,11 @@ class KvPushRouter:
             async for msg in sub:
                 try:
                     m = json.loads(msg.data)
-                    self.push.monitor.update(int(m["worker_id"]), float(m.get("kv_usage", 0.0)))
+                    wid = int(m["worker_id"])
+                    self.push.monitor.update(wid, float(m.get("kv_usage", 0.0)))
+                    self.elastic_fraction_by_worker[wid] = float(
+                        m.get("elastic_prefill_fraction", 0.5) or 0.5
+                    )
                 except (ValueError, KeyError):
                     continue
         except asyncio.CancelledError:
@@ -172,6 +180,7 @@ class KvPushRouter:
                     self.pending_index.remove_worker(w)
                 if self.prefill_counters is not None:
                     self.prefill_counters.remove_worker(w)
+                self.elastic_fraction_by_worker.pop(w, None)
         for w in live:
             self.sequences.ensure_worker(w)
         return live
@@ -205,6 +214,7 @@ class KvPushRouter:
             overlap_score_weight=overrides.get("overlap_score_weight"),
             temperature=overrides.get("temperature"),
             external_prefill_tokens=external,
+            prefill_fractions=self.elastic_fraction_by_worker,
         )
 
     async def generate(self, request: Any, context: Optional[Context] = None) -> AsyncIterator[Annotated]:

@@ -55,12 +55,14 @@ class KvScheduler:
         overlap_score_weight: Optional[float] = None,
         temperature: Optional[float] = None,
         external_prefill_tokens: Optional[Dict[WorkerId, int]] = None,
+        prefill_fractions: Optional[Dict[WorkerId, float]] = None,
     ) -> SchedulingDecision:
         if not workers:
             raise ValueError("no workers to select from")
         w_weight = self.overlap_score_weight if overlap_score_weight is None else overlap_score_weight
         temp = self.temperature if temperature is None else temperature
         external = external_prefill_tokens or {}
+        fractions = prefill_fractions or {}
 
         costs: List[Tuple[WorkerId, float, int]] = []
         for w in workers:
@@ -73,7 +75,13 @@ class KvScheduler:
             # (ref: prefill_counter.rs PrefillCountersMultiWorker).
             pending = self.sequences.prefill_tokens(w) + external.get(w, 0)
             pending_prefill_blocks = pending / max(self.sequences.block_size, 1)
-            cost = w_weight * (potential_prefill_blocks + pending_prefill_blocks) + decode_blocks
+            # Elastic capacity dial: a worker dialed toward prefill
+            # (fraction > 0.5) clears prefill blocks proportionally faster,
+            # so its prefill cost shrinks by the same 2·f factor the dial
+            # scales mixed_prefill_budget by (f = 0.5 ⇒ exact pre-elastic
+            # cost; gossiped via ForwardPassMetrics.elastic_prefill_fraction).
+            pf_scale = 1.0 / max(2.0 * fractions.get(w, 0.5), 0.1)
+            cost = w_weight * (potential_prefill_blocks + pending_prefill_blocks) * pf_scale + decode_blocks
             costs.append((w, cost, overlap))
 
         chosen = self._softmax_sample(costs, temp)

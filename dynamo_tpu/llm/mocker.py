@@ -109,6 +109,7 @@ class _Seq:
         forced: Optional[List[int]] = None,
         deadline_ms: Optional[float] = None,
         prefill_done: bool = False,
+        prefill_len: Optional[int] = None,
     ):
         self.request_id = request_id
         self.tokens = tokens
@@ -117,8 +118,11 @@ class _Seq:
         # Disaggregated decode leg: the prompt's KV "arrived by transfer"
         # (the real scheduler's disagg_inject) — blocks are allocated but
         # no prefill compute is simulated and no prefix is matched or
-        # registered (transferred KV is not reuse).
+        # registered (transferred KV is not reuse). prefill_len < prompt
+        # length marks a token-boundary SPLIT leg: only the first
+        # prefill_len tokens transferred; the rest prefills locally.
         self.prefill_done = prefill_done
+        self.prefill_len = len(tokens) if prefill_len is None else prefill_len
         self.arrival_ts = time.monotonic()
         self.deadline_ts = (
             self.arrival_ts + deadline_ms / 1000.0 if deadline_ms else None
@@ -185,6 +189,18 @@ class MockTpuEngine:
         self.input_tokens_total = 0
         self.output_tokens_total = 0
         self.disagg_prefill_done_total = 0  # decode legs admitted with transferred KV
+        # Elastic capacity dial: same semantics as Scheduler.set_capacity_dial
+        # (budget split re-derived around the configured bases), so planner
+        # stacks and the traffic harness exercise ratio shifts engine-free.
+        self._base_prefill_chunk = self.args.max_prefill_chunk
+        self._base_max_batch = self.args.max_batch
+        self._elastic_fraction = 0.5
+        self.elastic_dial_changes_total = 0
+        # Degradation-ladder counters (same families as the disagg handler's
+        # scrape): the handler — or a harness standing in for it — reports
+        # mode transitions here so mocker fleets emit the engine's keys.
+        self.degrade_disagg_to_colocated_total = 0
+        self.degrade_colocated_to_disagg_total = 0
         self._step_n = 0  # chaos-plane step counter (worker.step site passes)
         self.last_step_ms = 0.0  # most recent simulated step duration
         self.last_step_ts: Optional[float] = None  # stall-watchdog reference
@@ -215,6 +231,37 @@ class MockTpuEngine:
     def set_kv_event_sink(self, sink: Callable[[KvEvent], None]) -> None:
         self._sink = sink
 
+    # --- elastic capacity dial ---------------------------------------------
+    def set_capacity_dial(self, prefill_fraction: float) -> dict:
+        """Re-split the simulated budget between prefill and decode, live —
+        the mocker mirror of Scheduler.set_capacity_dial (same clamps, same
+        f=0.5 ⇒ configured-identity), reachable via the same ``set_dial``
+        control op when served behind an endpoint."""
+        f = min(1.0, max(0.0, float(prefill_fraction)))
+        bs = self.args.block_size
+        raw = int(round(2.0 * f * self._base_prefill_chunk))
+        budget = max(bs, min(raw, self._base_prefill_chunk))
+        slots = int(round(2.0 * (1.0 - f) * self._base_max_batch))
+        slots = max(1, min(self._base_max_batch, slots))
+        self._elastic_fraction = f
+        self.args.max_prefill_chunk = budget
+        self.args.max_batch = slots
+        self.elastic_dial_changes_total += 1
+        logger.info("mocker capacity dial: prefill_fraction=%.3f → prefill_chunk=%d decode_slots=%d",
+                    f, budget, slots)
+        return {"prefill_fraction": f, "mixed_prefill_budget": budget, "decode_slots": slots}
+
+    def note_degrade(self, direction: str) -> None:
+        """Record a degradation-ladder transition on this worker's scrape
+        (the disagg handler owns the decision; mocker fleets without one
+        let the harness call this so the degrade_* families still flow)."""
+        if direction == "disagg_to_colocated":
+            self.degrade_disagg_to_colocated_total += 1
+        elif direction == "colocated_to_disagg":
+            self.degrade_colocated_to_disagg_total += 1
+        else:
+            raise ValueError(f"unknown degrade direction: {direction}")
+
     # --- AsyncEngine --------------------------------------------------------
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         tokens: List[int] = list(request.get("token_ids") or [])
@@ -227,17 +274,27 @@ class MockTpuEngine:
         # legacy "prefill_done" flag. Honor both so the mocker behaves like
         # the real engine when it stands in for one behind the disagg
         # handler ("prefill_done" itself is baselined in dtlint_baseline).
-        prefilled = bool(request.get("prefill_done") or request.get("_prefilled"))
+        pref = request.get("_prefilled") or request.get("prefill_done")
+        prefilled = bool(pref)
+        # Token-boundary split legs: a dict _prefilled may carry
+        # "prefill_len" = N (< prompt length) — the first N tokens arrived
+        # as transferred KV; the remainder prefills locally, exactly the
+        # real scheduler's partial-inject path.
+        prefill_len = len(tokens)
+        if isinstance(pref, dict) and pref.get("prefill_len") is not None:
+            prefill_len = min(int(pref["prefill_len"]), len(tokens))
         if not prefilled:
             # Disagg decode legs carry the prompt for context accounting but
             # prefill none of it — counting their input tokens would double
             # the observer's prefill-demand estimate (rate × ISL).
             self.input_tokens_total += len(tokens)
+        elif prefill_len < len(tokens):
+            self.input_tokens_total += len(tokens) - prefill_len  # the local remainder
         forced = self._guided_tokens(request.get("guided_decoding"))
         seq = _Seq(
             f"mock-{self.request_total}", tokens, max_tokens, context,
             forced=forced, deadline_ms=float(deadline_ms) if deadline_ms else None,
-            prefill_done=prefilled,
+            prefill_done=prefilled, prefill_len=prefill_len,
         )
         self.waiting.append(seq)
         self._ensure_loop()
@@ -459,23 +516,29 @@ class MockTpuEngine:
         args = self.args
         bs = args.block_size
         if seq.computed == 0 and not seq.block_ids and seq.prefill_done and seq.recompute == 0:
-            # Disagg decode leg: KV for the whole prompt was transferred in.
-            # Allocate the blocks it occupies, skip the prefill simulation
-            # entirely, and leave the prefix cache untouched (transferred
-            # blocks are private — counting them as cache hits would
-            # poison the router's warmth accounting). After a preemption
-            # the transferred KV is gone and the normal recompute path runs.
+            # Disagg decode leg: KV for (the first prefill_len tokens of)
+            # the prompt was transferred in. Allocate the blocks the full
+            # sequence occupies, skip the prefill simulation for the
+            # transferred span, and leave the prefix cache untouched
+            # (transferred blocks are private — counting them as cache hits
+            # would poison the router's warmth accounting). A SPLIT leg
+            # (prefill_len < prompt) falls through to chunked prefill for
+            # the remainder. After a preemption the transferred KV is gone
+            # and the normal recompute path runs.
             needed = (seq.total_len + 1 + bs - 1) // bs
             if not self._allocate(seq, needed, preempt=False):
                 return 0
-            seq.computed = seq.prefill_span
+            n_pref = min(seq.prefill_len, len(seq.tokens))
+            full = n_pref >= len(seq.tokens)
+            seq.computed = seq.prefill_span if full else n_pref
             self.disagg_prefill_done_total += 1
             if seq.admitted_ts is None:
                 seq.admitted_ts = time.monotonic()
                 self.telemetry.observe(
                     "queue_wait", max(0.0, seq.admitted_ts - seq.arrival_ts)
                 )
-            return 0
+            if full:
+                return 0
         if seq.computed == 0 and not seq.block_ids:
             seq.hashes = compute_block_hashes(seq.tokens, bs)
             matched = self.allocator.match_prefix(seq.hashes)
@@ -604,6 +667,10 @@ class MockTpuEngine:
             prefix_hit_blocks_total=self.allocator.hit_blocks_total,
             prefix_miss_blocks_total=self.allocator.miss_blocks_total,
             prefix_evicted_blocks_total=self.allocator.evicted_blocks_total,
+            elastic_prefill_fraction=self._elastic_fraction,
+            elastic_prefill_budget=self.args.max_prefill_chunk,
+            elastic_decode_slots=self.args.max_batch,
+            elastic_dial_changes_total=self.elastic_dial_changes_total,
         )
 
     def stats_handler(self) -> dict:
@@ -637,6 +704,15 @@ class MockTpuEngine:
             "input_tokens_total": self.input_tokens_total,
             "output_tokens_total": self.output_tokens_total,
             "disagg_prefill_done_total": self.disagg_prefill_done_total,
+            # Elastic capacity dial + degradation ladder: same key families
+            # as the engine scrape (stats_handler) and the disagg handler's,
+            # so planner stacks exercise ratio shifts engine-free.
+            "elastic_prefill_fraction": self._elastic_fraction,
+            "elastic_prefill_budget": self.args.max_prefill_chunk,
+            "elastic_decode_slots": self.args.max_batch,
+            "elastic_dial_changes_total": self.elastic_dial_changes_total,
+            "degrade_disagg_to_colocated_total": self.degrade_disagg_to_colocated_total,
+            "degrade_colocated_to_disagg_total": self.degrade_colocated_to_disagg_total,
         }
         # Chaos plane: injected-fault counters, same keys as the engine's
         # scrape (only present on chaos-armed workers).

@@ -109,6 +109,9 @@ class LLMEngineOutput:
     finish_reason: Optional[str] = None
     cum_log_probs: Optional[float] = None
     logprobs: Optional[List[float]] = None  # per-token chosen logprobs (aligned with token_ids)
+    # Per-token top-k alternatives (OpenAI ``top_logprobs``): one
+    # [[alt_token_id, logprob], ...] list per token_ids entry.
+    top_logprobs: Optional[List[list]] = None
     index: int = 0
     # Set by the Backend parser stage on the final frame (OpenAI wire shape).
     tool_calls: Optional[List[dict]] = None
@@ -124,6 +127,8 @@ class LLMEngineOutput:
             d["cum_log_probs"] = self.cum_log_probs
         if self.logprobs is not None:
             d["logprobs"] = self.logprobs
+        if self.top_logprobs is not None:
+            d["top_logprobs"] = self.top_logprobs
         if self.tool_calls is not None:
             d["tool_calls"] = self.tool_calls
         if self.reasoning is not None:
@@ -138,6 +143,7 @@ class LLMEngineOutput:
             finish_reason=d.get("finish_reason"),
             cum_log_probs=d.get("cum_log_probs"),
             logprobs=d.get("logprobs"),
+            top_logprobs=d.get("top_logprobs"),
             index=d.get("index", 0),
             tool_calls=d.get("tool_calls"),
             reasoning=d.get("reasoning"),

@@ -51,9 +51,6 @@ def validate_chat_request(body: dict) -> dict:
         "top_logprobs must be an integer in [0, 20]",
     )
     _require(tlp is None or bool(lp), "top_logprobs requires logprobs: true")
-    # Only chosen-token logprobs are computed today; reject rather than
-    # silently return empty alternatives.
-    _require(not tlp, "top_logprobs > 0 is not supported (chosen-token logprobs only)")
     stop = body.get("stop")
     _require(
         stop is None or isinstance(stop, str) or (isinstance(stop, list) and all(isinstance(s, str) for s in stop)),
@@ -232,6 +229,13 @@ def sampling_from_request(body: dict) -> Dict[str, Any]:
     lp = body.get("logprobs")
     if lp is not None and lp is not False:
         out["logprobs"] = True
+    tlp = body.get("top_logprobs")
+    if tlp:
+        out["top_logprobs"] = int(tlp)
+    elif isinstance(lp, int) and not isinstance(lp, bool) and lp > 0:
+        # Completions: the logprobs int doubles as the top-k alternatives
+        # count (OpenAI legacy semantics).
+        out["top_logprobs"] = int(lp)
     lb = body.get("logit_bias")
     if lb:
         # Normalize keys to ints for the wire (OpenAI clients send strings).
@@ -259,28 +263,64 @@ def make_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex[:24]}"
 
 
-def chat_logprobs_content(text: Optional[str], logprobs: List[float]) -> dict:
+def _top_entries(alts: Optional[list]) -> List[dict]:
+    """Alternative-token entries for one position from the wire shape
+    [[alt_token_id, logprob], ...]. Alternatives are identified by token id
+    (``token_id:<n>``): per-alternative detokenization is not meaningful for
+    tokens that were never generated into the stream, and the id form is
+    lossless where a context-free decode of a lone id is not."""
+    if not alts:
+        return []
+    return [
+        {"token": f"token_id:{int(tid)}", "logprob": float(lp), "bytes": None}
+        for tid, lp in alts
+    ]
+
+
+def chat_logprobs_content(
+    text: Optional[str], logprobs: List[float], top_logprobs: Optional[List[list]] = None
+) -> dict:
     """Chat logprobs block for one delta/message: one entry per generated
-    token (chosen-token logprob; ``top_logprobs`` entries are not populated
-    beyond the chosen token)."""
+    token (chosen-token logprob; ``top_logprobs`` alternatives populated when
+    the engine computed them — wire shape [[alt_token_id, logprob], ...] per
+    token, aligned with ``logprobs``)."""
     toks = [text] if (text and len(logprobs) == 1) else [""] * len(logprobs)
+    tops = top_logprobs or []
     return {
         "content": [
-            {"token": t, "logprob": lp, "bytes": list(t.encode()) if t else None, "top_logprobs": []}
-            for t, lp in zip(toks, logprobs)
+            {
+                "token": t,
+                "logprob": lp,
+                "bytes": list(t.encode()) if t else None,
+                "top_logprobs": _top_entries(tops[i] if i < len(tops) else None),
+            }
+            for i, (t, lp) in enumerate(zip(toks, logprobs))
         ]
     }
 
 
-def completion_logprobs_block(texts: List[str], logprobs: List[float]) -> dict:
+def completion_logprobs_block(
+    texts: List[str], logprobs: List[float], top_logprobs: Optional[List[list]] = None
+) -> dict:
     """Completions-style logprobs arrays (tokens / token_logprobs).
     ``text_offset`` is omitted: per-token character offsets are not tracked
     through streaming detokenization, and an empty array misaligned with
-    ``tokens`` is worse for zip/index consumers than absence."""
+    ``tokens`` is worse for zip/index consumers than absence.
+    ``top_logprobs`` is the legacy per-position dict-of-alternatives form
+    when the engine computed them, else None."""
+    tops = None
+    if top_logprobs:
+        tops = [
+            {e["token"]: e["logprob"] for e in _top_entries(alts)}
+            for alts in top_logprobs
+        ]
+        # Pad to alignment with tokens if the engine emitted fewer positions.
+        while len(tops) < len(logprobs):
+            tops.append({})
     return {
         "tokens": texts,
         "token_logprobs": logprobs,
-        "top_logprobs": None,
+        "top_logprobs": tops,
     }
 
 

@@ -54,6 +54,11 @@ GAUGE_KEYS = (
     # Pallas launch sites traced into one fused decode-window executable
     # (must be exactly 1; CI asserts — see flight_recorder).
     "fused_window_pallas_launches",
+    # Elastic capacity dial: the live prefill:decode split each worker is
+    # running (fraction ∈ [0,1]; 0.5 = configured identity) and the budget /
+    # slot values it resolves to, plus the planner's fleet-wide ratio target.
+    "elastic_prefill_fraction", "elastic_prefill_budget", "elastic_decode_slots",
+    "planner_elastic_ratio",
 )
 
 # Fleet-level digest families the aggregator re-exports (merged across
@@ -130,6 +135,11 @@ COUNTER_KEYS = (
     "faults_crash_total", "faults_hang_total", "faults_stream_drop_total",
     "faults_delay_total", "faults_partition_total", "faults_lease_drop_total",
     "faults_stats_blackout_total", "faults_slow_total",
+    # Elastic prefill/decode (ISSUE 14): dial moves, degradation-ladder
+    # transitions in both directions, and token-boundary prefill splits.
+    "elastic_dial_changes_total",
+    "degrade_disagg_to_colocated_total", "degrade_colocated_to_disagg_total",
+    "split_prefills_total", "planner_dial_total",
 )
 
 

@@ -173,12 +173,15 @@ def rank_coldest(workers: Sequence[WorkerView], n: int) -> List[int]:
 @dataclass
 class Decision:
     pool: str
-    action: str  # "add" | "drain" | "hold"
-    count: int  # workers added/drained (0 for hold)
+    action: str  # "add" | "drain" | "hold" | "dial"
+    count: int  # workers added/drained (0 for hold/dial)
     target: int  # desired size after gating
     current: int
     victims: List[int] = field(default_factory=list)  # drain: coldest-first ids
     reason: str = ""
+    # "dial" only: the commanded fleet-wide prefill fraction (every worker's
+    # set_capacity_dial argument — the elastic ratio actuator's payload).
+    fraction: float = 0.5
 
 
 @dataclass
@@ -199,6 +202,13 @@ class ControllerConfig:
     kv_pressure: float = 0.9  # mean decode kv_util above this bumps decode
     load_predictor: str = "trend"
     dry_run: bool = False  # log + count decisions, actuator skips them
+    # Elastic ratio actuator: between scale events the fleet-wide
+    # prefill:decode capacity split tracks the observed ISL/OSL mix via the
+    # per-worker dial (set_capacity_dial) — far cheaper than a scale event
+    # (no launch/drain transient). A deadband + min-interval keep the dial
+    # from chattering on quantile noise.
+    dial_deadband: float = 0.05
+    dial_min_interval_s: float = 30.0
 
     def bounds(self, pool: str) -> tuple:
         if pool == PREFILL:
@@ -229,6 +239,10 @@ class AutoscaleController:
         self.hysteresis_suppressed_total = 0
         self.cooldown_suppressed_total = 0
         self.drain_debounced_total = 0
+        # Ratio actuator state: last commanded fleet-wide prefill fraction.
+        self.dial_total = 0
+        self._elastic_ratio = 0.5
+        self._last_dial_ts: Optional[float] = None
         self._targets: Dict[str, int] = {PREFILL: 0, DECODE: 0}
         self._trace_id = uuid.uuid4().hex
 
@@ -302,6 +316,39 @@ class AutoscaleController:
                     " [dry-run]" if c.dry_run else "",
                 )
         return out
+
+    # --- elastic ratio actuator --------------------------------------------
+    def decide_dial(self, load: ObservedLoad, now: float) -> Optional[Decision]:
+        """Track the observed ISL/OSL mix with the per-worker capacity dial
+        *between* scale events: the fraction of fleet work that is prefill
+        (per-token prefill cost × ISL vs per-token decode cost × OSL, from
+        the same CapacityModel ``decide`` inverts) becomes every worker's
+        commanded prefill fraction. Pure like ``decide`` — the actuation
+        (MockerFleet.apply / the ``set_dial`` control op) lives elsewhere."""
+        c = self.config
+        if load.request_rate <= 0:
+            return None  # idle fleet: nothing to track, hold the dial
+        isl = max(load.avg_isl, 1.0)
+        osl = max(load.avg_osl, 1.0)
+        pre = isl / max(self.capacity.prefill_tokens_per_s(isl), 1e-9)
+        dec = osl / max(self.capacity.decode_tokens_per_s(isl, osl), 1e-9)
+        f = pre / (pre + dec) if (pre + dec) > 0 else 0.5
+        f = min(1.0, max(0.0, f))
+        if abs(f - self._elastic_ratio) < c.dial_deadband:
+            return None
+        if self._last_dial_ts is not None and now - self._last_dial_ts < c.dial_min_interval_s:
+            return None
+        prev = self._elastic_ratio
+        self._last_dial_ts = now
+        self._elastic_ratio = f
+        self.dial_total += 1
+        d = Decision(
+            "fleet", "dial", 0, 0, 0, fraction=f,
+            reason=f"isl/osl mix: prefill_fraction {prev:.2f} -> {f:.2f}",
+        )
+        self._trace(d, load)
+        logger.info("planner dial: %s%s", d.reason, " [dry-run]" if c.dry_run else "")
+        return d
 
     def _gate(self, pool: str, current: int, target: int, view: FleetView, now: float) -> Decision:
         c = self.config
@@ -378,4 +425,6 @@ class AutoscaleController:
             "planner_prefill_target": float(self._targets.get(PREFILL, 0)),
             "planner_decode_target": float(self._targets.get(DECODE, 0)),
             "planner_dry_run": 1.0 if self.config.dry_run else 0.0,
+            "planner_dial_total": self.dial_total,
+            "planner_elastic_ratio": self._elastic_ratio,
         }

@@ -202,6 +202,28 @@ class MockerFleet:
                     victims = rank_coldest(self.view().pools.get(d.pool, ()), d.count)
                 for v in victims:
                     self.drain_worker(d.pool, v)
+            elif d.action == "dial":
+                self.set_dial(d.fraction)
+
+    def set_dial(self, prefill_fraction: float) -> int:
+        """Apply the ratio actuator's commanded prefill fraction to every
+        live worker (the in-process mirror of broadcasting the ``set_dial``
+        control op); returns how many workers took the dial. New workers
+        launched later start at their configured split — the next dial
+        decision re-aligns them."""
+        applied = 0
+        for workers in self.pools.values():
+            for w in workers:
+                dial = getattr(w.engine, "set_capacity_dial", None)
+                if dial is None:
+                    continue
+                try:
+                    dial(prefill_fraction)
+                    applied += 1
+                except Exception:  # noqa: BLE001 — one bad worker must not stop the sweep
+                    logger.exception("fleet: set_capacity_dial failed on %x", w.worker_id)
+        logger.info("fleet: dial %.3f applied to %d worker(s)", prefill_fraction, applied)
+        return applied
 
     # --- planner observability ----------------------------------------------
     async def serve_planner(self, controller: AutoscaleController):
@@ -263,9 +285,13 @@ class AutoscaleLoop:
         load = await self.observe_fn()
         router_stats = self.router_stats_fn() if self.router_stats_fn else None
         view = self.fleet.view(router_stats)
-        decisions = self.controller.decide(
-            load, view, time.monotonic() if now is None else now
-        )
+        ts = time.monotonic() if now is None else now
+        decisions = self.controller.decide(load, view, ts)
+        # Ratio actuator: between scale events, the per-worker capacity dial
+        # tracks the observed ISL/OSL mix (no launch/drain transient).
+        dial = self.controller.decide_dial(load, ts)
+        if dial is not None:
+            decisions.append(dial)
         self.decision_log.extend(d for d in decisions if d.action != "hold")
         if not self.controller.config.dry_run:
             await self.fleet.apply(decisions)

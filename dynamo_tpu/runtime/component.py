@@ -358,6 +358,25 @@ class _PushEndpoint:
             except Exception:
                 continue
             op = payload.get("op")
+            if op == "set_dial":
+                # Elastic capacity dial: re-split this worker's prefill vs
+                # decode budget live (engine.set_capacity_dial → scheduler).
+                # Ack over reply_to when the caller wants the applied values.
+                dial = getattr(self.engine, "set_capacity_dial", None)
+                if dial is None:
+                    logger.warning("set_dial received but engine exposes no capacity dial")
+                    continue
+                try:
+                    applied = dial(float(payload.get("prefill_fraction", 0.5)))
+                    logger.info("set_dial applied on %s: %s", self.instance.endpoint, applied)
+                except Exception as e:
+                    logger.exception("set_dial failed")
+                    applied = {"error": str(e)}
+                if msg.reply_to:
+                    await self.drt.bus.publish(
+                        msg.reply_to, msgpack.packb(applied, use_bin_type=True)
+                    )
+                continue
             if op in ("cancel", "kill"):
                 ctx = self.in_flight.get(payload.get("request_id", ""))
                 if ctx is not None:

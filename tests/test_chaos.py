@@ -906,3 +906,131 @@ async def test_model_survives_drain_of_one_backing_worker():
         await watcher.stop()
     finally:
         await drt.shutdown()
+
+
+# --- elastic: faults during ratio shifts + degradation-ladder flips -----------
+async def test_crash_during_ratio_shift_zero_token_loss():
+    """A worker crash lands in the middle of a fleet-wide ratio shift (both
+    workers' capacity dials reshaped while the stream is in flight): the
+    migrated continuation is still bit-identical — the dial moves capacity,
+    never tokens."""
+    drt = await DistributedRuntime.detached()
+    migrations = []
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaose1", on_migrate=lambda: migrations.append(1),
+            speedup_ratio=1.0, itl_base_ms=20.0)
+        faults.arm(faults.FaultInjector(
+            [{"site": "worker.step", "kind": "crash", "after": 4}], seed=7))
+
+        t0 = time.monotonic()
+        stream = asyncio.create_task(collect(engine, req(range(10), max_tokens=16)))
+        # Straddle the armed crash (fires on the 5th step, ~100ms in) with a
+        # two-move ratio shift across the whole fleet.
+        await asyncio.sleep(0.05)
+        for mocker, _ in workers:
+            mocker.set_capacity_dial(0.9)
+        await asyncio.sleep(0.05)
+        for mocker, _ in workers:
+            mocker.set_capacity_dial(0.3)
+
+        got, finish = await stream
+        elapsed = time.monotonic() - t0
+        assert got == list(range(10, 26)), got
+        assert finish == "length"
+        assert len(migrations) == 1
+        assert faults.get_injector().to_stats()["faults_crash_total"] == 1
+        assert elapsed < 10.0, f"recovery took {elapsed:.1f}s"
+        for mocker, _ in workers:
+            assert mocker.elastic_dial_changes_total == 2
+        assert_drained(workers)
+    finally:
+        await drt.shutdown()
+
+
+async def test_lease_loss_during_ratio_shift_migrates_exactly_once():
+    """Lease expiry evicts a worker while a ratio shift sweeps the fleet:
+    the router must still evict before the next route and the migrated
+    stream loses nothing — a dial move is never an excuse for token loss."""
+    drt = await DistributedRuntime.detached()
+    migrations = []
+    try:
+        ep = drt.namespace("chaose2").component("w").endpoint("gen")
+        victim, h_victim = await spawn_worker(
+            drt, ep, lease_ttl_s=0.5, speedup_ratio=1.0, itl_base_ms=40.0)
+        vid = h_victim.instance.instance_id
+        faults.arm(faults.FaultInjector([
+            {"site": "lease.keepalive", "kind": "lease_drop", "count": 0,
+             "match": {"lease": f"{vid:x}"}},
+        ], seed=7))
+        survivor, h_surv = await spawn_worker(
+            drt, ep, speedup_ratio=1.0, itl_base_ms=40.0)
+        client = await ep.client()
+        await client.wait_for_instances(2, timeout=5)
+        router = PushRouter(client, retry=RetryPolicy(max_retries=2, backoff_base_s=0.01, seed=0))
+        engine = Migration(2, on_migrate=lambda: migrations.append(1)).attach(RouterEngine(router))
+        router._rr = sorted(client.instances).index(vid)
+
+        stream_task = asyncio.create_task(collect(engine, req(range(10), max_tokens=60)))
+        # The ratio shift lands while the victim's lease is already dying.
+        await asyncio.sleep(0.1)
+        victim.set_capacity_dial(0.8)
+        survivor.set_capacity_dial(0.8)
+
+        deadline = time.monotonic() + 5.0
+        while vid in client.instances and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert vid not in client.instances, "lease expiry did not evict the instance"
+        assert stream_task.done() is False, "stream should still be mid-flight"
+        victim._crash_all()
+
+        got, finish = await stream_task
+        assert got == list(range(10, 70)), "migrated stream lost or duplicated tokens"
+        assert finish == "length"
+        assert len(migrations) == 1
+        assert survivor.elastic_dial_changes_total == 1
+        assert survivor.allocator.num_active == 0
+    finally:
+        await drt.shutdown()
+
+
+async def test_crash_during_degrade_to_colocated_zero_token_loss():
+    """The degradation ladder under fire: a saturated prefill pool degrades
+    the request disagg→co-located, and the co-located worker then CRASHES
+    mid-stream. The degraded leg rides the same router+migration machinery
+    as any request — exact tokens, one migration, bounded recovery."""
+    from dynamo_tpu.llm.disagg import DisaggDecodeHandler
+
+    drt = await DistributedRuntime.detached()
+    migrations = []
+    try:
+        _, client, router, engine, workers = await chaos_stack(
+            drt, "chaose3", on_migrate=lambda: migrations.append(1),
+            speedup_ratio=1.0, itl_base_ms=20.0)
+        # A live prefill pool the probe declares saturated: the proactive
+        # rung fires BEFORE any wire hop, so the pool stays untouched.
+        prefill_ep = drt.namespace("chaose3").component("prefill").endpoint("gen")
+        p_engine, p_handle = await spawn_worker(drt, prefill_ep)
+        prefill_client = await prefill_ep.client()
+        await prefill_client.wait_for_instances(1, timeout=5)
+        handler = DisaggDecodeHandler(
+            drt, engine, prefill_client,
+            pool_load_probe=lambda: {"prefill_saturated": True})
+
+        faults.arm(faults.FaultInjector(
+            [{"site": "worker.step", "kind": "crash", "after": 4}], seed=7))
+        t0 = time.monotonic()
+        got, finish = await collect(handler, req(range(10), max_tokens=16))
+        elapsed = time.monotonic() - t0
+
+        assert got == list(range(10, 26)), got
+        assert finish == "length"
+        assert handler.degrade_disagg_to_colocated_total == 1
+        assert handler.local_prefills == 1 and handler.remote_prefills == 0
+        assert len(migrations) == 1, "the crash must fire inside the degraded leg"
+        assert faults.get_injector().to_stats()["faults_crash_total"] == 1
+        assert elapsed < 10.0, f"recovery took {elapsed:.1f}s"
+        assert_drained(workers)
+        assert p_engine.allocator.num_active == 0  # the pool never saw the request
+    finally:
+        await drt.shutdown()

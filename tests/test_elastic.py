@@ -1,0 +1,408 @@
+"""Elastic prefill/decode tests (ROADMAP item 2): the per-worker capacity
+dial (scheduler + mocker mirror + ``set_dial`` control op), token-boundary
+request splits across workers (bit-identical to single-worker serving, KV
+back to baseline on both sides, deadline folding), the planner's ratio
+actuator (``decide_dial`` gates + fleet sweep), and the KV router's
+dial-aware cost term. Ref: DynaServe arXiv:2504.09285 (continuous-ratio
+pools); tests/test_disagg.py carries the non-split transfer coverage."""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from dynamo_tpu.llm.kv_router import ActiveSequencesMultiWorker, KvScheduler
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+from dynamo_tpu.llm.mocker import MockEngineArgs, MockTpuEngine
+from dynamo_tpu.metrics_aggregator import COUNTER_KEYS, GAUGE_KEYS
+from dynamo_tpu.planner.controller import (
+    DECODE,
+    PREFILL,
+    AutoscaleController,
+    ControllerConfig,
+    Decision,
+    StaticCapacityModel,
+)
+from dynamo_tpu.planner.fleet import MockerFleet
+from dynamo_tpu.planner.planner_core import ObservedLoad
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from tests.test_disagg import build_engine, collect, req, setup_disagg
+
+ELASTIC_GAUGES = (
+    "elastic_prefill_fraction",
+    "elastic_prefill_budget",
+    "elastic_decode_slots",
+)
+ELASTIC_COUNTERS = (
+    "elastic_dial_changes_total",
+    "degrade_disagg_to_colocated_total",
+    "degrade_colocated_to_disagg_total",
+    "split_prefills_total",
+)
+
+
+def load(rate, isl=100.0, osl=16.0):
+    return ObservedLoad(request_rate=rate, avg_isl=isl, avg_osl=osl)
+
+
+# --- scheduler dial (real engine) --------------------------------------------
+async def test_scheduler_capacity_dial_identity_extremes_and_stats():
+    """f=0.5 is the configured identity; f→1 doubles the mixed chunk budget
+    (clamped to max_prefill_chunk) and shrinks decode slots to 1; f→0 pins
+    the budget at one block while slots stay at the configured cap. The
+    applied values ride the stats scrape."""
+    engine = build_engine()
+    sch = engine.scheduler
+    base_budget = sch._base_mixed_prefill_budget
+    base_slots = sch._base_max_running
+    bs = sch.mc.block_size
+
+    applied = engine.set_capacity_dial(0.5)
+    assert applied == {
+        "prefill_fraction": 0.5,
+        "mixed_prefill_budget": min(base_budget, sch.sc.max_prefill_chunk),
+        "decode_slots": base_slots,
+    }
+
+    applied = engine.set_capacity_dial(1.0)
+    assert applied["mixed_prefill_budget"] == min(2 * base_budget, sch.sc.max_prefill_chunk)
+    assert applied["decode_slots"] == 1
+    assert sch.sc.max_running == 1
+
+    applied = engine.set_capacity_dial(0.0)
+    assert applied["mixed_prefill_budget"] == bs
+    assert applied["decode_slots"] == base_slots
+
+    # Out-of-range inputs clamp instead of wedging the worker.
+    assert engine.set_capacity_dial(7.3)["prefill_fraction"] == 1.0
+    assert engine.set_capacity_dial(-2.0)["prefill_fraction"] == 0.0
+
+    stats = engine.stats_handler()
+    assert stats["elastic_prefill_fraction"] == 0.0
+    assert stats["elastic_prefill_budget"] == bs
+    assert stats["elastic_decode_slots"] == base_slots
+    assert stats["elastic_dial_changes_total"] == 5
+    await engine.stop()
+
+
+async def test_dial_shrink_then_restore_serves_correctly():
+    """A live engine serves identically before, during, and after a dial
+    swing — the shrunken decode-slot cap must not strand admitted work."""
+    engine = build_engine()
+    prompt = list(range(20, 52))
+    ref, fin = await collect(engine, req(prompt))
+    assert fin == "length" and len(ref) == 6
+
+    engine.set_capacity_dial(1.0)  # decode slots → 1
+    out, fin = await collect(engine, req(prompt))
+    assert out == ref and fin == "length"
+
+    engine.set_capacity_dial(0.5)  # back to the configured identity
+    out, fin = await collect(engine, req(prompt))
+    assert out == ref and fin == "length"
+    assert engine.scheduler.allocator.num_active == 0
+    await engine.stop()
+
+
+# --- token-boundary splits ----------------------------------------------------
+async def test_split_prefill_bit_identical_and_kv_baseline():
+    """The elastic split contract: a request prefilled for its first
+    ``split_at`` tokens on worker A and completed on worker B emits the
+    exact token stream a single worker would, folds its deadline across the
+    hop, and leaves BOTH allocators at baseline."""
+
+    class _Capture:
+        """Delegating engine shim so the test can see the decode-leg request
+        exactly as the handler forwarded it."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.requests = []
+
+        def generate(self, request, context):
+            self.requests.append(request)
+            return self.inner.generate(request, context)
+
+        def stats_handler(self):
+            return self.inner.stats_handler()
+
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(drt)
+        cap = _Capture(decode_engine)
+        handler.engine = cap
+        prompt = list(range(20, 68))  # 48 tokens, split after 2 blocks
+
+        ref_engine = build_engine()
+        ref, _ = await collect(ref_engine, req(prompt))
+        await ref_engine.stop()
+
+        r = req(prompt)
+        r["disagg_params"] = {"split_at": 32}
+        r["stop_conditions"]["deadline_ms"] = 60000.0
+        out, fin = await collect(handler, r)
+
+        assert out == ref, f"split-prefill stream {out} != single-worker {ref}"
+        assert fin == "length"
+        assert handler.remote_prefills == 1 and handler.split_prefills_total == 1
+
+        # The decode leg carried the partial-injection marker and a folded
+        # deadline: remaining budget, never the original (the hop already
+        # spent wall clock) and never zero (max_tokens still governs).
+        local_req = cap.requests[-1]
+        assert local_req["_prefilled"]["prefill_len"] == 32
+        folded = local_req["stop_conditions"]["deadline_ms"]
+        assert 0.0 < folded < 60000.0
+        assert local_req["stop_conditions"]["max_tokens"] == 6
+
+        # KV baseline on both workers: the export was consumed on A, and
+        # B's blocks free once the stream finishes.
+        assert prefill_engine.scheduler.allocator.num_active == 0
+        assert not prefill_engine.scheduler._pending_exports
+        for _ in range(100):
+            if decode_engine.scheduler.allocator.num_active == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert decode_engine.scheduler.allocator.num_active == 0
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+async def test_split_at_rejects_degenerate_boundaries():
+    """split_at below one block or past the prompt is ignored (classic full
+    handoff) — the knob can shape work, never corrupt it."""
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(drt)
+        prompt = list(range(20, 60))
+
+        ref_engine = build_engine()
+        ref, _ = await collect(ref_engine, req(prompt))
+        await ref_engine.stop()
+
+        for bad in (1, len(prompt), len(prompt) + 50):
+            r = req(prompt)
+            r["disagg_params"] = {"split_at": bad}
+            out, fin = await collect(handler, r)
+            assert out == ref and fin == "length"
+        assert handler.split_prefills_total == 0
+        assert handler.remote_prefills == 3
+        assert prefill_engine.scheduler.allocator.num_active == 0
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+# --- proactive degradation ladder ---------------------------------------------
+async def test_probe_degrades_both_directions_and_counts():
+    """The load probe flips routing in BOTH directions before any wire hop:
+    a saturated pool degrades disagg→co-located, a saturated local engine
+    offloads co-located→disagg. Each flip lands on the paired counter and
+    the stats scrape."""
+    drt = await DistributedRuntime.detached()
+    try:
+        handler, prefill_engine, decode_engine, kvx, handle = await setup_disagg(drt)
+        probe = {"prefill_saturated": True}
+        handler.pool_load_probe = lambda: probe
+        prompt = list(range(20, 60))
+
+        out, fin = await collect(handler, req(prompt))
+        assert fin == "length" and len(out) == 6
+        assert handler.local_prefills == 1 and handler.remote_prefills == 0
+        assert handler.degrade_disagg_to_colocated_total == 1
+
+        # Reverse rung needs the length rule to say "local" first.
+        from dynamo_tpu.llm.disagg import DisaggRouter, DisaggRouterConf
+
+        handler.disagg_router = DisaggRouter(
+            drt, "tiny", conf=DisaggRouterConf(max_local_prefill_length=100)
+        )
+        probe.clear()
+        probe["local_saturated"] = True
+        out, fin = await collect(handler, req(prompt))  # 40 < 100 ⇒ local, overridden
+        assert fin == "length" and len(out) == 6
+        assert handler.remote_prefills == 1
+        assert handler.degrade_colocated_to_disagg_total == 1
+
+        stats = handler.stats_handler()
+        assert stats["degrade_disagg_to_colocated_total"] == 1
+        assert stats["degrade_colocated_to_disagg_total"] == 1
+        assert stats["split_prefills_total"] == 0
+
+        await kvx.stop()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+    finally:
+        await drt.shutdown()
+
+
+# --- mocker mirror ------------------------------------------------------------
+def test_mocker_dial_mirrors_scheduler_contract():
+    m = MockTpuEngine(MockEngineArgs(max_batch=4, max_prefill_chunk=256))
+    bs = m.args.block_size
+
+    applied = m.set_capacity_dial(0.5)
+    assert applied == {"prefill_fraction": 0.5, "mixed_prefill_budget": 256, "decode_slots": 4}
+
+    assert m.set_capacity_dial(0.0)["mixed_prefill_budget"] == bs
+    assert m.args.max_batch == 4
+    assert m.set_capacity_dial(1.0)["decode_slots"] == 1
+    assert m.set_capacity_dial(9.0)["prefill_fraction"] == 1.0
+    assert m.elastic_dial_changes_total == 4
+
+    m.note_degrade("disagg_to_colocated")
+    m.note_degrade("colocated_to_disagg")
+    with pytest.raises(ValueError, match="unknown degrade direction"):
+        m.note_degrade("sideways")
+
+
+def test_mocker_stats_families_match_engine_and_aggregator():
+    """WIRE001 triangle: the mocker scrape carries the same elastic/degrade
+    key families as the real engine scrape, and every one of them is
+    registered in the aggregator's export tuples."""
+    m = MockTpuEngine(MockEngineArgs())
+    m.set_capacity_dial(0.75)
+    m.note_degrade("disagg_to_colocated")
+    stats = m.stats_handler()
+    registered = set(GAUGE_KEYS) | set(COUNTER_KEYS)
+    for key in ELASTIC_GAUGES + ELASTIC_COUNTERS:
+        if key == "split_prefills_total":
+            continue  # the disagg handler's counter, not a worker scrape key
+        assert key in stats, f"mocker scrape missing {key}"
+        assert key in registered, f"{key} not registered with the aggregator"
+    assert "split_prefills_total" in registered
+    assert stats["elastic_prefill_fraction"] == 0.75
+    assert stats["degrade_disagg_to_colocated_total"] == 1
+
+    # The dial gossips to routers on the metrics wire (ForwardPassMetrics).
+    assert m.metrics().to_wire()["elastic_prefill_fraction"] == 0.75
+
+
+def test_planner_keys_registered():
+    for key in ("planner_elastic_ratio",):
+        assert key in GAUGE_KEYS
+    for key in ("planner_dial_total",):
+        assert key in COUNTER_KEYS
+
+
+# --- set_dial control op ------------------------------------------------------
+async def test_set_dial_control_op_end_to_end():
+    """The live-adjust path a planner actuator uses across processes:
+    publish ``set_dial`` on the worker's control subject, the worker applies
+    it to its engine and acks the applied values over reply_to."""
+    drt = await DistributedRuntime.detached()
+    try:
+        engine = MockTpuEngine(MockEngineArgs(max_batch=4, max_prefill_chunk=256))
+        ep = drt.namespace("elasticctl").component("w").endpoint("gen")
+        handle = await ep.serve_endpoint(engine, stats_handler=engine.stats_handler)
+
+        reply_subject = "elastic_test.dial_ack"
+        sub = await drt.bus.subscribe(reply_subject)
+        await drt.bus.publish(
+            handle.instance.control_subject,
+            msgpack.packb({"op": "set_dial", "prefill_fraction": 0.9}, use_bin_type=True),
+            reply_to=reply_subject,
+        )
+        msg = await sub.next(timeout=5.0)
+        assert msg is not None, "set_dial never acked"
+        applied = msgpack.unpackb(msg.data, raw=False)
+        assert applied["prefill_fraction"] == 0.9
+        assert applied["decode_slots"] == 1
+        assert engine._elastic_fraction == 0.9
+        assert engine.stats_handler()["elastic_dial_changes_total"] == 1
+        await sub.unsubscribe()
+    finally:
+        await drt.shutdown()
+
+
+# --- planner ratio actuator ---------------------------------------------------
+def test_decide_dial_tracks_isl_osl_mix():
+    c = AutoscaleController(
+        ControllerConfig(dial_deadband=0.05, dial_min_interval_s=30.0),
+        StaticCapacityModel(400.0, 80.0, utilization=1.0),
+    )
+    # Prefill-heavy mix: pre = 400/400 = 1.0s, dec = 16/80 = 0.2s.
+    d = c.decide_dial(load(4.0, isl=400.0, osl=16.0), now=0.0)
+    assert d is not None and d.action == "dial" and d.pool == "fleet"
+    assert d.fraction == pytest.approx(1.0 / 1.2)
+    assert d.count == 0  # a dial is not a scale event
+
+    # Idle fleet holds the dial.
+    assert c.decide_dial(load(0.0), now=40.0) is None
+
+    # Deadband: the same mix again is a no-op, whatever the clock says.
+    assert c.decide_dial(load(4.0, isl=400.0, osl=16.0), now=100.0) is None
+
+    # Min interval: a genuinely new mix still waits out the chatter guard.
+    decode_heavy = load(4.0, isl=100.0, osl=100.0)
+    assert c.decide_dial(decode_heavy, now=10.0) is None
+    d2 = c.decide_dial(decode_heavy, now=40.0)
+    assert d2 is not None
+    assert d2.fraction == pytest.approx(0.25 / 1.5)
+
+    stats = c.to_stats()
+    assert stats["planner_dial_total"] == 2
+    assert stats["planner_elastic_ratio"] == pytest.approx(0.25 / 1.5)
+
+
+async def test_fleet_apply_sweeps_dial_to_all_workers():
+    drt = await DistributedRuntime.detached()
+    try:
+        fleet = MockerFleet(
+            drt, "elasticfleet",
+            make_args=lambda component: MockEngineArgs(speedup_ratio=50.0),
+            publish_kv_events=False,
+        )
+        await fleet.add_worker(PREFILL)
+        await fleet.add_worker(DECODE)
+        await fleet.apply([Decision("fleet", "dial", 0, 0, 0, fraction=0.8)])
+        for pool in (PREFILL, DECODE):
+            for w in fleet.pools[pool]:
+                assert w.engine._elastic_fraction == 0.8
+                assert w.engine.elastic_dial_changes_total == 1
+    finally:
+        await drt.shutdown()
+
+
+# --- KV-router dial-aware cost ------------------------------------------------
+def test_router_cost_identity_at_half_dial():
+    """f = 0.5 on every worker reproduces the pre-elastic cost exactly —
+    the dial term is invisible until someone actually moves a dial."""
+    seqs = ActiveSequencesMultiWorker(block_size=16)
+    sched = KvScheduler(seqs)
+    base = sched.select_worker([1], prompt_blocks=6, overlaps=OverlapScores(scores={1: 2}))
+    dialed = sched.select_worker(
+        [1], prompt_blocks=6, overlaps=OverlapScores(scores={1: 2}),
+        prefill_fractions={1: 0.5},
+    )
+    assert dialed.cost == base.cost
+
+
+def test_router_prefers_prefill_dialed_worker_for_prefill_heavy_work():
+    seqs = ActiveSequencesMultiWorker(block_size=16)
+    sched = KvScheduler(seqs)
+    # Identical workers, identical (zero) overlap: the one dialed toward
+    # prefill clears the prompt's blocks faster, so it must win.
+    d = sched.select_worker(
+        [1, 2], prompt_blocks=8, overlaps=OverlapScores(),
+        prefill_fractions={1: 0.9, 2: 0.1},
+    )
+    assert d.worker == 1
+
+    # Decode cost is dial-independent: with no prefill work left the
+    # fractions cannot tip the choice toward a loaded worker.
+    for i in range(10):
+        seqs.add_request(f"r{i}", 1, prompt_tokens=64, overlap_blocks=0)
+        seqs.mark_prefill_done(f"r{i}")
+    d = sched.select_worker(
+        [1, 2], prompt_blocks=0, overlaps=OverlapScores(),
+        prefill_fractions={1: 0.9, 2: 0.1},
+    )
+    assert d.worker == 2

@@ -30,7 +30,7 @@ def chat_body(**kw):
     (chat_body(seed="abc"), "seed must be"),
     (chat_body(logprobs=3), "logprobs must be a boolean"),
     (chat_body(top_logprobs=5), "top_logprobs requires"),
-    (chat_body(logprobs=True, top_logprobs=5), "top_logprobs > 0 is not supported"),
+    (chat_body(logprobs=True, top_logprobs=21), "top_logprobs must be an integer in"),
     (chat_body(temperature=9.0), "temperature must be in"),
     (chat_body(logit_bias=[1, 2]), "logit_bias must be an object"),
     (chat_body(logit_bias={"abc": 1}), "logit_bias keys must be token ids"),
@@ -39,6 +39,35 @@ def chat_body(**kw):
 def test_chat_validation_errors(body, frag):
     with pytest.raises(oai.RequestError, match=frag):
         oai.validate_chat_request(body)
+
+
+def test_top_logprobs_accepted_and_mapped():
+    body = chat_body(logprobs=True, top_logprobs=5)
+    assert oai.validate_chat_request(body) is body
+    s = oai.sampling_from_request(body)
+    assert s["logprobs"] is True and s["top_logprobs"] == 5
+    # Completions: the legacy int doubles as the alternatives count.
+    comp = {"model": "m", "prompt": "hi", "logprobs": 3}
+    assert oai.validate_completion_request(comp) is comp
+    s = oai.sampling_from_request(comp)
+    assert s["logprobs"] is True and s["top_logprobs"] == 3
+
+
+def test_logprobs_block_builders_with_tops():
+    tops = [[[7, -0.1], [9, -2.0]], [[4, -0.5]]]
+    blk = oai.chat_logprobs_content(None, [-0.1, -0.5], tops)
+    assert [e["logprob"] for e in blk["content"]] == [-0.1, -0.5]
+    assert blk["content"][0]["top_logprobs"] == [
+        {"token": "token_id:7", "logprob": -0.1, "bytes": None},
+        {"token": "token_id:9", "logprob": -2.0, "bytes": None},
+    ]
+    cblk = oai.completion_logprobs_block(["a", "b"], [-0.1, -0.5], tops)
+    assert cblk["top_logprobs"] == [
+        {"token_id:7": -0.1, "token_id:9": -2.0},
+        {"token_id:4": -0.5},
+    ]
+    # Without alternatives the block keeps its pre-elastic shape.
+    assert oai.completion_logprobs_block(["a"], [-0.1])["top_logprobs"] is None
 
 
 def test_logit_bias_accepted_and_normalized():
