@@ -87,6 +87,16 @@ class EngineArgs:
     # Attach a short jax.profiler device capture to each bundle (TPU
     # diagnosis: was the spike device time or host time?).
     profile_on_incident: bool = False
+    # Continuous device-truth sampler (runtime/profiling.ContinuousProfiler):
+    # short programmatic profiler windows at a bounded duty cycle, parsed
+    # into measured MFU / per-kernel top-N siblings of the modeled gauges.
+    # On by default — its defaults are a <1% duty cycle and the first
+    # window only opens a full interval after startup.
+    continuous_profiling: bool = True
+    profile_window_s: float = 0.25
+    profile_interval_s: float = 30.0
+    # Artifact root for ALL capture paths (falls back to DYN_PROFILE_DIR).
+    profile_dir: Optional[str] = None
 
 
 class TpuEngine:
@@ -126,6 +136,15 @@ class TpuEngine:
             flight_probe=scheduler.flight.ring_snapshot,
             config_probe=scheduler.config_snapshot,
         )
+        # Device-truth profiling plane: ONE DeviceProfiler per engine — the
+        # serialization point every capture path (health server POST,
+        # incident captures, continuous sampler) must share — and the
+        # optional background sampler build() arms. Engines constructed
+        # directly (tests) get the profiler but no sampler thread.
+        from dynamo_tpu.runtime.profiling import DeviceProfiler
+
+        self.profiler = DeviceProfiler()
+        self.continuous_profiler = None
 
     # --- construction -------------------------------------------------------
     @classmethod
@@ -209,13 +228,13 @@ class TpuEngine:
         from dynamo_tpu.runtime.incidents import INCIDENT_DIR_ENV, IncidentConfig, IncidentPlane
 
         incident_dir = args.incident_dir or _os.environ.get(INCIDENT_DIR_ENV) or None
-        profiler = None
-        if args.profile_on_incident:
-            from dynamo_tpu.runtime.profiling import DeviceProfiler
-
-            profiler = DeviceProfiler(
-                out_dir=_os.path.join(incident_dir, "profiles") if incident_dir else None
-            )
+        # One shared DeviceProfiler for every capture path — incident
+        # captures, the health server's POST /debug/profile, and the
+        # continuous sampler all serialize through its capture lock.
+        if args.profile_dir:
+            engine.profiler.out_dir = args.profile_dir
+        elif args.profile_on_incident and incident_dir:
+            engine.profiler.out_dir = _os.path.join(incident_dir, "profiles")
         engine.incidents = IncidentPlane(
             IncidentConfig(
                 dir=incident_dir,
@@ -225,8 +244,25 @@ class TpuEngine:
             state_probe=engine.debug_state,
             flight_probe=engine.scheduler.flight.ring_snapshot,
             config_probe=engine.scheduler.config_snapshot,
-            profiler=profiler,
+            profiler=engine.profiler,
         )
+        if args.continuous_profiling:
+            from dynamo_tpu.runtime.profiling import (
+                ContinuousProfileConfig,
+                ContinuousProfiler,
+            )
+
+            flight = engine.scheduler.flight
+            engine.continuous_profiler = ContinuousProfiler(
+                engine.profiler,
+                ContinuousProfileConfig(
+                    window_s=args.profile_window_s,
+                    interval_s=args.profile_interval_s,
+                ),
+                cost_probe=flight.roofline_totals,
+                sink=flight.record_measured_window,
+            )
+            engine.continuous_profiler.start()
         if args.kvbm_host_blocks > 0:
             from dynamo_tpu.llm.block_manager import KvBlockManager
 
@@ -252,6 +288,8 @@ class TpuEngine:
     async def stop(self) -> None:
         self._closed = True
         self._wake.set()
+        if self.continuous_profiler is not None:
+            await asyncio.to_thread(self.continuous_profiler.stop)
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
@@ -522,8 +560,13 @@ class TpuEngine:
         }
         # Flight recorder: per-phase step/token counters + the XLA compile
         # tracker (compiles_after_warmup_total > 0 in steady state is the
-        # alert that shapes are compiling mid-traffic — PR 1's silent killer).
+        # alert that shapes are compiling mid-traffic — PR 1's silent killer)
+        # + the measured device-truth siblings once profile windows landed.
         stats.update(self.scheduler.flight.to_stats())
+        # Continuous device-truth sampler: window/skip/error counters and
+        # the live duty-cycle gauge (pure dict assembly — no device work).
+        if self.continuous_profiler is not None:
+            stats.update(self.continuous_profiler.to_stats())
         # KV-pool utilization gauges (free/cached depth, fragmentation,
         # prefix hit rate) + the SLO/goodput account + stall-watchdog state.
         stats.update(self.scheduler.kv_gauges())

@@ -138,7 +138,15 @@ class StepCostModel:
     """
 
     __slots__ = ("param_count", "param_bytes", "kv_bytes_per_token",
-                 "kv_read_factor", "peak_flops", "peak_bw")
+                 "kv_read_factor", "peak_flops", "peak_bw",
+                 "flops_per_token", "calibrated", "calibration_source")
+
+    # XLA's own count must land within this band of the 2·params hand count
+    # to be trusted: a wildly different number means the probe measured the
+    # wrong executable (or cost_analysis returned transcendental-op noise),
+    # and silently adopting it would skew every mfu_* gauge and the
+    # measured-vs-modeled tolerance gate downstream.
+    CALIBRATION_BAND = (0.2, 5.0)
 
     def __init__(self, param_count: int, param_bytes: int, kv_bytes_per_token: float,
                  peak_flops: Optional[float] = None, peak_bw: Optional[float] = None,
@@ -151,6 +159,29 @@ class StepCostModel:
             peak_flops, peak_bw = detect_peaks()
         self.peak_flops = peak_flops
         self.peak_bw = peak_bw
+        # Hand-rolled default; Scheduler warmup replaces it with XLA's own
+        # cost_analysis() count of the decode executable when available.
+        self.flops_per_token = 2.0 * self.param_count
+        self.calibrated = False
+        self.calibration_source = "analytical"
+
+    def calibrate(self, flops_per_token: float, source: str = "xla_cost_analysis") -> bool:
+        """Adopt a measured FLOPs-per-token count (normally from
+        ``jax.stages.Compiled.cost_analysis()``). Rejected outside the
+        sanity band around the analytical count — returns whether adopted."""
+        hand = 2.0 * self.param_count
+        lo, hi = self.CALIBRATION_BAND
+        if not (flops_per_token > 0 and lo * hand <= flops_per_token <= hi * hand):
+            logger.warning(
+                "rejecting cost_analysis calibration %.3g flops/token "
+                "(analytical %.3g, accepted band [%.1fx, %.1fx])",
+                flops_per_token, hand, lo, hi,
+            )
+            return False
+        self.flops_per_token = float(flops_per_token)
+        self.calibrated = True
+        self.calibration_source = source
+        return True
 
     def step_cost(
         self, tokens: int, kv_read_tokens: int, param_passes: float = 1.0
@@ -164,7 +195,7 @@ class StepCostModel:
         step), and 1 again for the fused megakernel window (weights are
         VMEM-resident for the whole window; that is the launch-amortization
         win the gauge must show)."""
-        flops = 2.0 * self.param_count * tokens
+        flops = self.flops_per_token * tokens
         bytes_moved = (
             self.param_bytes * max(param_passes, 1.0)
             + kv_read_tokens * self.kv_bytes_per_token * self.kv_read_factor
@@ -184,17 +215,19 @@ class _PhaseRoofline:
     window. A bounded deque of recent steps, so a quiet engine's MFU decays
     to reflect recent traffic rather than all-time averages."""
 
-    __slots__ = ("recent", "flops_total", "bytes_total")
+    __slots__ = ("recent", "flops_total", "bytes_total", "secs_total")
 
     def __init__(self, maxlen: int = 256):
         self.recent: deque = deque(maxlen=maxlen)  # (flops, bytes, dur_s)
         self.flops_total = 0.0
         self.bytes_total = 0.0
+        self.secs_total = 0.0
 
     def record(self, flops: float, bytes_moved: float, dur_s: float) -> None:
         self.recent.append((flops, bytes_moved, dur_s))
         self.flops_total += flops
         self.bytes_total += bytes_moved
+        self.secs_total += dur_s
 
     def live(self, peak_flops: float, peak_bw: float) -> Tuple[float, float]:
         """(MFU, HBM-roofline fraction) over the recent-step window."""
@@ -245,6 +278,77 @@ class FlightRecorder:
         # Last-step snapshot (gauge-style, for quick introspection).
         self.last_step_phase: Optional[str] = None
         self.last_step_s = 0.0
+        # Measured device truth (ContinuousProfiler windows). Written from
+        # the profiler thread — distinct fields with a single writer, read
+        # by the scrape; last-write-wins is fine for monitoring data.
+        self.measured_windows_total = 0
+        self.measured_device_seconds_total = 0.0
+        self.measured_wall_seconds_total = 0.0
+        self._measured_last: Optional[dict] = None
+
+    # --- measured device truth ----------------------------------------------
+    def roofline_totals(self) -> Tuple[float, float, float, int]:
+        """Cumulative (flops, bytes, modeled step seconds, fused windows)
+        across every phase — the ContinuousProfiler's cost probe. Deltas of
+        this across a profile window attribute measured device time to the
+        modeled work done in the same span."""
+        f = b = s = 0.0
+        for r in self._roofline.values():
+            f += r.flops_total
+            b += r.bytes_total
+            s += r.secs_total
+        return f, b, s, self.fused_windows_total
+
+    def record_measured_window(self, record: dict) -> None:
+        """Fold one profile window's measured truth into the recorder.
+
+        ``record`` is the ContinuousProfiler's per-window dict (or a bench
+        fixture shaped the same): wall_s, device_time_s, flops, bytes,
+        step_seconds, top_kernels, top_kernel_share,
+        launches_per_fused_window. Derived gauges:
+
+        - ``measured_mfu`` / ``measured_hbm_frac``: modeled work ÷ MEASURED
+          device-busy time ÷ peak — the measured sibling of ``mfu_*``.
+        - ``measured_modeled_mfu_ratio``: modeled step seconds ÷ measured
+          device seconds over the same span. 1.0 means the cost model's
+          wall clock and the device's own account agree; the bench asserts
+          a tolerance band on the fixture path.
+        """
+        device_s = max(float(record.get("device_time_s", 0.0)), 0.0)
+        flops = max(float(record.get("flops", 0.0)), 0.0)
+        bytes_moved = max(float(record.get("bytes", 0.0)), 0.0)
+        step_s = max(float(record.get("step_seconds", 0.0)), 0.0)
+        self.measured_windows_total += 1
+        self.measured_device_seconds_total += device_s
+        self.measured_wall_seconds_total += float(record.get("wall_s", 0.0))
+        mfu = hbm = 0.0
+        if self.cost_model is not None and device_s > 0:
+            mfu = flops / device_s / self.cost_model.peak_flops
+            hbm = bytes_moved / device_s / self.cost_model.peak_bw
+        ratio = (step_s / device_s) if device_s > 0 else 0.0
+        self._measured_last = {
+            "measured_mfu": round(mfu, 6),
+            "measured_hbm_frac": round(hbm, 6),
+            "measured_device_frac": (
+                round(device_s / float(record["wall_s"]), 6)
+                if record.get("wall_s") else 0.0
+            ),
+            "measured_modeled_mfu_ratio": round(ratio, 6),
+            "measured_top_kernel_share": round(
+                float(record.get("top_kernel_share", 0.0)), 6
+            ),
+            "measured_launches_per_fused_window": (
+                round(float(record["launches_per_fused_window"]), 6)
+                if record.get("launches_per_fused_window") is not None else 0.0
+            ),
+            "top_kernels": record.get("top_kernels", []),
+        }
+
+    def measured_snapshot(self) -> Optional[dict]:
+        """Last measured window's derived gauges + kernel top-N (bench and
+        incident-bundle view); None before the first window."""
+        last = self._measured_last
+        return dict(last) if last else None
 
     # --- step accounting ----------------------------------------------------
     def set_cost_model(self, model: StepCostModel) -> None:
@@ -421,6 +525,22 @@ class FlightRecorder:
                 mfu, hbm = r.live(self.cost_model.peak_flops, self.cost_model.peak_bw)
                 out[f"mfu_{phase}"] = round(mfu, 6)
                 out[f"hbm_frac_{phase}"] = round(hbm, 6)
+            out["cost_model_calibrated"] = 1.0 if self.cost_model.calibrated else 0.0
+        if self.measured_windows_total:
+            out["measured_windows_total"] = self.measured_windows_total
+            out["measured_device_seconds_total"] = round(
+                self.measured_device_seconds_total, 6
+            )
+            out["measured_wall_seconds_total"] = round(
+                self.measured_wall_seconds_total, 6
+            )
+            last = self._measured_last or {}
+            for key in (
+                "measured_mfu", "measured_hbm_frac", "measured_device_frac",
+                "measured_modeled_mfu_ratio", "measured_top_kernel_share",
+                "measured_launches_per_fused_window",
+            ):
+                out[key] = last.get(key, 0.0)
         return out
 
     def histogram(self, phase: str) -> Tuple[Tuple[float, ...], List[int]]:

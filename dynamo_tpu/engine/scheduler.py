@@ -1623,6 +1623,36 @@ class Scheduler:
         serving-plane cost.)"""
         return width_bucket(max_used, self.max_blocks_per_seq)
 
+    def _calibrate_cost_model(self, bucket: int, width: int) -> None:
+        """Replace the cost model's hand-rolled 2·params FLOPs/token with
+        XLA's own count of the decode executable
+        (``jax.stages.Compiled.cost_analysis``) where the backend provides
+        one. Lowering happens BEFORE the warmup dispatch of the same shape —
+        ``lower()`` only records donation, it does not invalidate the live
+        cache buffers — and the compile lands in the same compilation cache
+        the warmup call hits. Failures degrade to the analytical model."""
+        cm = self.flight.cost_model
+        if cm is None:
+            return
+        try:
+            tpa = jnp.zeros((3, bucket), jnp.int32)
+            tables = jnp.zeros((bucket, width), jnp.int32)
+            compiled = self._decode_jit.lower(
+                self.params, self.cache.k, self.cache.v, tpa, tables
+            ).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            if flops > 0 and cm.calibrate(flops / max(bucket, 1)):
+                logger.info(
+                    "cost model calibrated from XLA cost_analysis: "
+                    "%.4g flops/token (analytical %.4g)",
+                    cm.flops_per_token, 2.0 * cm.param_count,
+                )
+        except Exception as e:  # noqa: BLE001 — calibration is best-effort
+            logger.debug("cost_analysis calibration unavailable: %s", e)
+
     def warmup(self, ctx_tokens: int = 2048) -> int:
         """Precompile the serving-hot executables so traffic never waits on
         XLA (the reference's engines warm up at startup for the same reason;
@@ -1637,6 +1667,9 @@ class Scheduler:
         widths = sorted(set(min(r, self.max_blocks_per_seq) for r in width_rungs(max_w)))
         count = 0
         key = jax.random.PRNGKey(0)
+        # Ask XLA for the decode executable's own FLOPs count before the
+        # first dispatch of the same shape compiles it for real.
+        self._calibrate_cost_model(self.sc.decode_buckets[0], widths[0])
         for bucket in self.sc.decode_buckets:
             for width in widths:
                 toks = jnp.zeros((bucket,), jnp.int32)

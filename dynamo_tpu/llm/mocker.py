@@ -189,6 +189,18 @@ class MockTpuEngine:
         self.input_tokens_total = 0
         self.output_tokens_total = 0
         self.disagg_prefill_done_total = 0  # decode legs admitted with transferred KV
+        # Per-phase step accounting, same families as the flight recorder's
+        # step_{phase}_* counters: the observer derives MEASURED per-worker
+        # tok/s from Δtokens/Δtime of these, so the ProfiledCapacityModel
+        # closes its loop on engine-free mocker fleets too. Time is wall
+        # clock (speedup applied) — the same clock MockerCapacityModel's
+        # declared rates are in.
+        self.step_prefill_steps_total = 0
+        self.step_prefill_tokens_total = 0
+        self.step_prefill_time_s = 0.0
+        self.step_decode_steps_total = 0
+        self.step_decode_tokens_total = 0
+        self.step_decode_time_s = 0.0
         # Elastic capacity dial: same semantics as Scheduler.set_capacity_dial
         # (budget split re-derived around the configured bases), so planner
         # stacks and the traffic harness exercise ratio shifts engine-free.
@@ -388,15 +400,17 @@ class MockTpuEngine:
                     self.running.append(seq)
                 else:
                     break  # blocked on KV blocks, or budget consumed mid-prompt
-            if wave_tokens:
-                step_ms += args.prefill_ms(wave_tokens)
+            pre_ms = args.prefill_ms(wave_tokens) if wave_tokens else 0.0
+            step_ms += pre_ms
 
             # Batched decode step: every running sequence produces one token;
             # latency depends on batch width and total active KV.
             decoding = [s for s in self.running if s.in_decode]
+            dec_ms = 0.0
             if decoding:
                 active_kv = sum(s.total_len for s in decoding)
-                step_ms += args.decode_ms(len(decoding), active_kv)
+                dec_ms = args.decode_ms(len(decoding), active_kv)
+                step_ms += dec_ms
 
             if step_ms == 0.0:
                 # Nothing admissible (block pressure): idle-wait a tick.
@@ -406,6 +420,18 @@ class MockTpuEngine:
             self.last_step_ms = step_ms
             await asyncio.sleep(step_ms / 1000.0 / args.speedup_ratio)
             self.last_step_ts = time.monotonic()
+            # Per-phase step accounting: each phase is charged its own
+            # simulated wall time (slow-factor included, so chaos slowdowns
+            # show up as genuinely reduced measured capacity).
+            scale = slow_factor / 1000.0 / args.speedup_ratio
+            if wave_tokens:
+                self.step_prefill_steps_total += 1
+                self.step_prefill_tokens_total += wave_tokens
+                self.step_prefill_time_s += pre_ms * scale
+            if decoding:
+                self.step_decode_steps_total += 1
+                self.step_decode_tokens_total += len(decoding)
+                self.step_decode_time_s += dec_ms * scale
             if decoding:
                 # Wall-clock step time = the ITL the wire observes.
                 self.telemetry.observe("itl", step_ms / 1000.0 / args.speedup_ratio)
@@ -713,7 +739,43 @@ class MockTpuEngine:
             "elastic_dial_changes_total": self.elastic_dial_changes_total,
             "degrade_disagg_to_colocated_total": self.degrade_disagg_to_colocated_total,
             "degrade_colocated_to_disagg_total": self.degrade_colocated_to_disagg_total,
+            # Per-phase step families (flight-recorder key parity): the
+            # observer's measured tok/s derivation reads Δtokens/Δseconds.
+            "step_prefill_steps_total": self.step_prefill_steps_total,
+            "step_prefill_tokens_total": self.step_prefill_tokens_total,
+            "step_prefill_time_seconds_total": round(self.step_prefill_time_s, 6),
+            "step_decode_steps_total": self.step_decode_steps_total,
+            "step_decode_tokens_total": self.step_decode_tokens_total,
+            "step_decode_time_seconds_total": round(self.step_decode_time_s, 6),
         }
+        # Device-truth parity: plausible synthetic measured siblings so the
+        # aggregator/Grafana/planner stack runs engine-free. The mocker's
+        # simulated clock IS its device, so the synthetic sampler reports
+        # one 250ms window per 30s of simulated busy time, 85% device-busy,
+        # a perfectly calibrated cost model, and the fused window holding
+        # its 1-launch invariant.
+        sim_busy_s = self.step_prefill_time_s + self.step_decode_time_s
+        windows = int(sim_busy_s / 30.0) + (1 if sim_busy_s > 0 else 0)
+        stats.update({
+            "device_profile_windows_total": windows,
+            "device_profile_window_seconds_total": round(windows * 0.25, 6),
+            "device_profile_skipped_busy_total": 0,
+            "device_profile_errors_total": 0,
+            "device_profile_duty_cycle": round(0.25 / 30.0, 6),
+            "cost_model_calibrated": 1.0,
+        })
+        if windows:
+            stats.update({
+                "measured_windows_total": windows,
+                "measured_device_seconds_total": round(windows * 0.25 * 0.85, 6),
+                "measured_wall_seconds_total": round(windows * 0.25, 6),
+                "measured_mfu": 0.45,
+                "measured_hbm_frac": 0.6,
+                "measured_device_frac": 0.85,
+                "measured_modeled_mfu_ratio": 1.0,
+                "measured_top_kernel_share": 0.55,
+                "measured_launches_per_fused_window": 1.0,
+            })
         # Chaos plane: injected-fault counters, same keys as the engine's
         # scrape (only present on chaos-armed workers).
         stats.update(faults.stats())

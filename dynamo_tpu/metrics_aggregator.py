@@ -59,6 +59,18 @@ GAUGE_KEYS = (
     # slot values it resolves to, plus the planner's fleet-wide ratio target.
     "elastic_prefill_fraction", "elastic_prefill_budget", "elastic_decode_slots",
     "planner_elastic_ratio",
+    # Device-truth profiling plane (ISSUE 15): the continuous sampler's live
+    # duty cycle, the measured (trace-derived) siblings of the modeled
+    # roofline gauges, the measured÷modeled cross-check ratio, and whether
+    # the cost model was calibrated from XLA cost_analysis.
+    "device_profile_duty_cycle",
+    "measured_mfu", "measured_hbm_frac", "measured_device_frac",
+    "measured_modeled_mfu_ratio", "measured_top_kernel_share",
+    "measured_launches_per_fused_window",
+    "cost_model_calibrated",
+    # Profile-derived capacity: EMA of measured per-worker tok/s the
+    # autoscale controller is currently steering on (0 until warm).
+    "planner_measured_prefill_tok_s", "planner_measured_decode_tok_s",
 )
 
 # Fleet-level digest families the aggregator re-exports (merged across
@@ -140,6 +152,15 @@ COUNTER_KEYS = (
     "elastic_dial_changes_total",
     "degrade_disagg_to_colocated_total", "degrade_colocated_to_disagg_total",
     "split_prefills_total", "planner_dial_total",
+    # Device-truth profiling plane (ISSUE 15): continuous-sampler window
+    # accounting (attempted windows, trace seconds, yields to on-demand
+    # captures, parse/capture errors), the flight-recorder fold of parsed
+    # windows, and capture-lock contention on the shared DeviceProfiler.
+    "device_profile_windows_total", "device_profile_window_seconds_total",
+    "device_profile_skipped_busy_total", "device_profile_errors_total",
+    "measured_windows_total", "measured_device_seconds_total",
+    "measured_wall_seconds_total",
+    "profiler_capture_conflicts_total",
 )
 
 

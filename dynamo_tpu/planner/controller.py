@@ -130,6 +130,74 @@ class StaticCapacityModel(CapacityModel):
         return self._dec
 
 
+class ProfiledCapacityModel(CapacityModel):
+    """Measured per-worker capacity with a declared-rate prior.
+
+    Wraps any prior :class:`CapacityModel`. Every decision interval the
+    controller feeds it the window's MEASURED per-worker token rates
+    (``ObservedLoad.measured_*_tok_s`` — fleet Δstep_tokens/Δstep_busy_time
+    from the flight recorder's counters, device-truth-audited by the
+    profiling plane); they fold into a per-phase EMA, and once
+    ``min_windows`` real observations exist the measured rate replaces the
+    prior's declared one in the capacity inversion. Declared rates drift
+    from reality (quantization, interference, chip revisions, model
+    changes); measurement closes the loop — the coordinated-autoscaling
+    ground paper's point (arXiv 2508.19559) — and the replay test shows the
+    decision table converging to the true-rate oracle from a wrong prior.
+    """
+
+    def __init__(self, prior: CapacityModel, alpha: float = 0.4,
+                 min_windows: int = 2, utilization: Optional[float] = None):
+        self.prior = prior
+        self.utilization = prior.utilization if utilization is None else utilization
+        self.alpha = alpha
+        self.min_windows = min_windows
+        self._pre_ema = 0.0
+        self._pre_n = 0
+        self._dec_ema = 0.0
+        self._dec_n = 0
+        self.observations_total = 0
+
+    def observe(self, load: ObservedLoad) -> None:
+        """Fold one observation window's measured rates in (zeros — no step
+        traffic that window — are skipped, never averaged in)."""
+        seen = False
+        if load.measured_prefill_tok_s > 0:
+            self._pre_n += 1
+            self._pre_ema = (
+                load.measured_prefill_tok_s if self._pre_n == 1
+                else self._pre_ema + self.alpha * (load.measured_prefill_tok_s - self._pre_ema)
+            )
+            seen = True
+        if load.measured_decode_tok_s > 0:
+            self._dec_n += 1
+            self._dec_ema = (
+                load.measured_decode_tok_s if self._dec_n == 1
+                else self._dec_ema + self.alpha * (load.measured_decode_tok_s - self._dec_ema)
+            )
+            seen = True
+        if seen:
+            self.observations_total += 1
+
+    def measured_rates(self) -> tuple:
+        """(prefill_tok_s, decode_tok_s) actually in use — 0.0 while a phase
+        still rides the prior (stats-gauge surface)."""
+        return (
+            self._pre_ema if self._pre_n >= self.min_windows else 0.0,
+            self._dec_ema if self._dec_n >= self.min_windows else 0.0,
+        )
+
+    def prefill_tokens_per_s(self, isl: float) -> float:
+        if self._pre_n >= self.min_windows:
+            return self._pre_ema
+        return self.prior.prefill_tokens_per_s(isl)
+
+    def decode_tokens_per_s(self, isl: float, osl: float) -> float:
+        if self._dec_n >= self.min_windows:
+            return self._dec_ema
+        return self.prior.decode_tokens_per_s(isl, osl)
+
+
 # --- fleet view (what the controller sees) ------------------------------------
 @dataclass
 class WorkerView:
@@ -286,6 +354,12 @@ class AutoscaleController:
     def decide(self, load: ObservedLoad, view: FleetView, now: float) -> List[Decision]:
         c = self.config
         self.decisions_total += 1
+        # Measured-capacity feedback: a ProfiledCapacityModel folds this
+        # window's measured tok/s in before the inversion below uses it.
+        # Stateful like the predictors — replays stay exactly reproducible.
+        observe = getattr(self.capacity, "observe", None)
+        if observe is not None:
+            observe(load)
         self.rate_predictor.observe(load.request_rate)
         self.isl_predictor.observe(load.avg_isl)
         self.osl_predictor.observe(load.avg_osl)
@@ -415,6 +489,8 @@ class AutoscaleController:
         """Planner decision counters/gauges on the stats-scrape wire (same
         shape the aggregator's COUNTER_KEYS/GAUGE_KEYS registries expect;
         the fleet serves this on a scraped ``planner`` endpoint)."""
+        rates_fn = getattr(self.capacity, "measured_rates", None)
+        rates = rates_fn() if rates_fn is not None else (0.0, 0.0)
         return {
             "planner_decisions_total": self.decisions_total,
             "planner_scale_up_total": self.scale_up_total,
@@ -427,4 +503,9 @@ class AutoscaleController:
             "planner_dry_run": 1.0 if self.config.dry_run else 0.0,
             "planner_dial_total": self.dial_total,
             "planner_elastic_ratio": self._elastic_ratio,
+            # Measured per-worker capacity in use (0.0 = riding the prior /
+            # not a ProfiledCapacityModel): the Grafana "Device truth" row
+            # shows when the planner switched from declared to measured.
+            "planner_measured_prefill_tok_s": round(rates[0], 3),
+            "planner_measured_decode_tok_s": round(rates[1], 3),
         }

@@ -176,6 +176,14 @@ class PrometheusObserver:
                 + delta_suffix("slo_tpot_attained_total")
             d_vio = delta_suffix("slo_violated_total") + delta_suffix("slo_ttft_violated_total") \
                 + delta_suffix("slo_tpot_violated_total")
+            # Measured per-worker capacity: tokens ÷ busy step time over the
+            # window (flight-recorder / mocker step_* families via the
+            # aggregator). Feeds ProfiledCapacityModel so the controller's
+            # inversion uses what workers DID, not what a model declared.
+            d_pre_tok = delta_suffix("step_prefill_tokens_total")
+            d_pre_s = delta_suffix("step_prefill_time_seconds_total")
+            d_dec_tok = delta_suffix("step_decode_tokens_total")
+            d_dec_s = delta_suffix("step_decode_time_seconds_total")
             load = ObservedLoad(
                 request_rate=d_req / dt,
                 avg_isl=d_in / d_req if d_req > 0 else 0.0,
@@ -189,6 +197,8 @@ class PrometheusObserver:
                 goodput_req_s=delta_suffix("goodput_requests_total") / dt,
                 goodput_tok_s=delta_suffix("goodput_tokens_total") / dt,
                 kv_util=self._gauge_mean(samples, "_kv_usage"),
+                measured_prefill_tok_s=d_pre_tok / d_pre_s if d_pre_s > 0 else 0.0,
+                measured_decode_tok_s=d_dec_tok / d_dec_s if d_dec_s > 0 else 0.0,
             )
         self._last = cur
         self._last_ts = now

@@ -67,6 +67,13 @@ class ObservedLoad:
     goodput_tok_s: float = 0.0
     # Mean KV-pool usage across workers (0..1).
     kv_util: float = 0.0
+    # MEASURED per-worker sustained token rates over the window: fleet-wide
+    # Δstep_{phase}_tokens / Δstep_{phase}_time_seconds (step time is
+    # per-worker busy time, so the quotient is tok/s per busy worker —
+    # exactly the capacity quantity declared rates approximate). 0.0 = no
+    # step traffic this window; ProfiledCapacityModel ignores zeros.
+    measured_prefill_tok_s: float = 0.0
+    measured_decode_tok_s: float = 0.0
 
 
 @dataclass

@@ -547,6 +547,12 @@ class IncidentPlane:
         out["profiler_captures_total"] = (
             self.profiler.captures_total if self.profiler is not None else 0
         )
+        # Capture-path collisions (incident vs continuous vs HTTP): each one
+        # used to be a silent drop; now every contender either queues or is
+        # refused WITH this counter ticking.
+        out["profiler_capture_conflicts_total"] = (
+            self.profiler.capture_conflicts_total if self.profiler is not None else 0
+        )
         return out
 
     def debug_info(self) -> dict:

@@ -96,6 +96,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="LRU retention cap on incident bundle files")
     p.add_argument("--profile-on-incident", action="store_true",
                    help="attach a short jax.profiler device capture to each incident bundle")
+    # Continuous device-truth sampler (runtime/profiling.py): short profiler
+    # windows at a bounded duty cycle feed measured MFU / per-kernel top-N
+    # siblings of the modeled roofline gauges.
+    p.add_argument("--no-continuous-profiling", action="store_true",
+                   help="disarm the background device-truth sampler")
+    p.add_argument("--profile-window-s", type=float, default=0.25,
+                   help="continuous sampler capture window (seconds)")
+    p.add_argument("--profile-interval-s", type=float, default=30.0,
+                   help="seconds between continuous capture windows (duty-cycle-clamped)")
+    p.add_argument("--profile-dir", default=None,
+                   help="artifact root for all device captures (default DYN_PROFILE_DIR)")
     p.add_argument("--warmup-ctx", type=int, default=0,
                    help="precompile serving executables for contexts up to this many tokens "
                         "(0 = lazy; the flight recorder then counts mid-traffic compiles)")
@@ -188,6 +199,10 @@ async def amain(args) -> None:
                 incident_dir=args.incident_dir,
                 incident_keep=args.incident_keep,
                 profile_on_incident=args.profile_on_incident,
+                continuous_profiling=not args.no_continuous_profiling,
+                profile_window_s=args.profile_window_s,
+                profile_interval_s=args.profile_interval_s,
+                profile_dir=args.profile_dir,
             )
         )
         if args.kvbm_remote and getattr(engine, "kvbm", None) is not None:
@@ -263,11 +278,14 @@ async def amain(args) -> None:
                         engine.scheduler.flight.compiles_after_warmup_total,
                 }
             )
-        # On-demand device profiling (POST /debug/profile?seconds=N): reuse
-        # the incident plane's profiler when --profile-on-incident armed
-        # one, else attach a fresh capture-on-request profiler.
+        # On-demand device profiling (POST /debug/profile?seconds=N): every
+        # capture path must share ONE DeviceProfiler (its capture lock is
+        # the serialization point vs incident and continuous captures), so
+        # prefer the engine's, then the incident plane's, then a fresh one.
         incidents = getattr(engine, "incidents", None)
-        profiler = incidents.profiler if incidents is not None else None
+        profiler = getattr(engine, "profiler", None)
+        if profiler is None and incidents is not None:
+            profiler = incidents.profiler
         if profiler is None:
             from dynamo_tpu.runtime.profiling import DeviceProfiler
 
