@@ -985,9 +985,10 @@ def bench_observability_overhead():
     fully sampled (sample=1.0, JSONL export live, trace ring + tail keep
     armed). The digests, SLO judge, FLOPs/bytes roofline model, stall
     watchdog, anomaly detector (polled at the production scrape cadence),
-    and the host stack sampler are LIVE in both phases — they are
-    always-on in production — so the section proves the whole diagnosis
-    plane rides inside the budget. The acceptance bar is ≤2%
+    the host stack sampler, and the tenant capacity ledger (every request
+    billed to a tenant) are LIVE in both phases — they are always-on in
+    production — so the section proves the whole diagnosis plane rides
+    inside the budget. The acceptance bar is ≤2%
     token-throughput cost at the bench knee with 0 post-warmup compiles."""
     import tempfile
 
@@ -1040,6 +1041,9 @@ def bench_observability_overhead():
                 f"p{p}r{i}", list(range(1 + (p + i) % 8, 33 + (p + i) % 8)),
                 SamplingParams(temperature=0.0), StopConditions(max_tokens=80),
                 trace=(f"{p:016x}{i:016x}", f"{i:016x}") if traced else None,
+                # Tenant ledger armed in BOTH phases (it is always-on in
+                # production): every request bills to one of two tenants.
+                tenant=f"bench-t{i % 2}",
             )
         while sched.has_work():
             tokens += sum(1 for _, o in sched.step() if o.token_id >= 0)
@@ -1145,6 +1149,22 @@ def bench_observability_overhead():
         assert faults_injected == 0, (
             f"armed-but-idle fault injector fired {faults_injected} times"
         )
+        # Tenant ledger armed throughout: every request billed, both
+        # tenants tracked, and the charged device-seconds conserve (Σ
+        # tracked + other = exact total — nothing leaks the sketch).
+        ledger_wire = sched.ledger.to_wire()
+        assert ledger_wire["bills"] == phase_counter[0] * 8, (
+            f"ledger billed {ledger_wire['bills']} of {phase_counter[0] * 8} requests"
+        )
+        from dynamo_tpu.runtime.ledger import SpaceSaving as _SpaceSaving
+
+        _tracked = {t for t, _, _ in _SpaceSaving.from_wire(
+            ledger_wire["sketches"]["device_seconds"]).items()}
+        assert _tracked == {"bench-t0", "bench-t1"}, _tracked
+        assert ledger_wire["totals"]["device_seconds"] > 0.0
+        assert plane.to_stats()["incidents_total"] == 0, (
+            "calm bench traffic fired a false incident"
+        )
     finally:
         _faults.disarm()
         configure_tracing(path=None, sample=0.0)  # leave the process clean
@@ -1216,6 +1236,16 @@ def bench_observability_overhead():
         # for the whole measured section (asserted above: thread alive,
         # zero errors, duty ≤ 2%).
         "continuous_profiler": {"armed": True, **cont_stats},
+        # Tenant capacity ledger armed in both phases: every request billed
+        # to one of two tenants, charges conserved, zero extra compiles —
+        # attribution is pure host arithmetic riding the same ≤2% budget.
+        "tenant_ledger": {
+            "armed": True,
+            "bills": ledger_wire["bills"],
+            "tenants_tracked": sorted(_tracked),
+            "device_seconds": round(ledger_wire["totals"]["device_seconds"], 4),
+            "kv_block_seconds": round(ledger_wire["totals"]["kv_block_seconds"], 4),
+        },
         # Incident autopsy plane armed for the whole section: detector
         # polled per round, trace ring + tail keep live, host stack
         # sampler running at its production period. Calm traffic must not

@@ -136,6 +136,12 @@ class TpuEngine:
             flight_probe=scheduler.flight.ring_snapshot,
             config_probe=scheduler.config_snapshot,
         )
+        # Tenant ledger snapshot rides every incident bundle (autopsy --tenant
+        # reads it); process-global like the router's decision ring — a
+        # rebuilt engine replaces its predecessor's probe.
+        from dynamo_tpu.runtime.incidents import register_evidence_probe
+
+        register_evidence_probe("tenant_ledger", scheduler.ledger.snapshot)
         # Device-truth profiling plane: ONE DeviceProfiler per engine — the
         # serialization point every capture path (health server POST,
         # incident captures, continuous sampler) must share — and the
@@ -372,6 +378,9 @@ class TpuEngine:
         extras = {
             "keep_blocks_on_finish": bool(disagg.get("do_remote_decode")),
             "prefilled": request.get("_prefilled"),
+            # Capacity-ledger attribution (runtime/ledger.py): resolved by
+            # the frontend, billed by the scheduler.
+            "tenant": request.get("tenant") or "anon",
         }
         guided = request.get("guided_decoding")
         if guided is not None:
@@ -576,6 +585,11 @@ class TpuEngine:
         # step durations): the aggregator merges these across workers into
         # true fleet-wide quantiles — averaging per-worker p99s does not.
         stats["digests"] = self.scheduler.telemetry.to_wire()
+        # Tenant capacity ledger: flat billed totals on the worker plane +
+        # the nested sketch wire the aggregator merges into fleet-true
+        # per-tenant top-K families (runtime/ledger.py).
+        stats.update(self.scheduler.ledger.to_stats())
+        stats["tenant_ledger"] = self.scheduler.ledger.to_wire()
         # Guided decoding: request + grammar-compile counters (scrape-
         # visible so dashboards can watch structured-output traffic).
         if self.scheduler.guided is not None:

@@ -42,6 +42,7 @@ import numpy as np
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.flight_recorder import FlightRecorder, StepCostModel, StepTimer
 from dynamo_tpu.engine.kv_cache import BlockAllocator, KvCacheArrays, KvEvent, OutOfBlocksError
+from dynamo_tpu.runtime.ledger import RequestBill, TenantLedger
 from dynamo_tpu.runtime.telemetry import SloConfig, SloJudge, Telemetry
 from dynamo_tpu.engine.models import llama
 from dynamo_tpu.engine.sampling import SamplingParams, guided_sample_batch, sample_batch
@@ -185,6 +186,18 @@ class Sequence:
     # GuidedState). The scheduler advances it host-side from each sampled
     # token and masks logits device-side via the shared mask pool.
     guided: Optional[object] = None
+    # Capacity-ledger attribution (runtime/ledger.py): the tenant this
+    # request bills to, plus the running bill accumulators. Device-seconds
+    # accrue per step in _bill_step; KV block-seconds accrue lazily from
+    # ``kv_ts`` (the clock starts when blocks are first held — COW-shared
+    # prefix blocks are in block_ids, so holders are charged too).
+    tenant: str = "anon"
+    bill_prefill_s: float = 0.0
+    bill_decode_s: float = 0.0
+    bill_flops: float = 0.0
+    bill_kv_block_s: float = 0.0
+    kv_ts: Optional[float] = None
+    billed: bool = False
 
     @property
     def all_ids(self) -> List[int]:
@@ -287,6 +300,10 @@ class SchedulerConfig:
     # while work is queued marks the engine stalled (unhealthy /health,
     # engine_stalled counter). Sized well past any legitimate cold compile.
     stall_after_s: float = 120.0
+    # Tenant capacity ledger: SpaceSaving sketch size — per-tenant digests
+    # and SLO counters exist only for the top-K set, so this bounds the
+    # ledger's memory regardless of tenant cardinality.
+    ledger_top_k: int = 16
 
 
 @dataclass
@@ -415,6 +432,14 @@ class Scheduler:
         # judge behind the goodput account. All host-side — no dispatches.
         self.telemetry = Telemetry(window_s=self.sc.telemetry_window_s)
         self.slo = SloJudge(SloConfig(ttft_ms=self.sc.slo_ttft_ms, tpot_ms=self.sc.slo_tpot_ms))
+        # Tenant capacity ledger: per-request bills (queue/device/KV-hold
+        # time, FLOPs, tokens) roll into bounded top-K heavy-hitter
+        # sketches + per-tenant SLO telemetry (runtime/ledger.py).
+        self.ledger = TenantLedger(
+            top_k=self.sc.ledger_top_k,
+            slo=SloConfig(ttft_ms=self.sc.slo_ttft_ms, tpot_ms=self.sc.slo_tpot_ms),
+            window_s=self.sc.telemetry_window_s,
+        )
         # Flight recorder: per-phase step histograms + XLA compile tracker
         # (every dispatch registers its shape key; keys first seen after
         # warmup are counted/logged). Tracer: per-request lifecycle events
@@ -772,6 +797,7 @@ class Scheduler:
         mm_features: Optional[np.ndarray] = None,
         trace: Optional[tuple] = None,
         guided: Optional[dict] = None,
+        tenant: str = "anon",
     ) -> Sequence:
         if not token_ids:
             raise ValueError("empty prompt")
@@ -797,6 +823,7 @@ class Scheduler:
             prefilled=prefilled,
             mm_features=mm_features,
             trace=trace,
+            tenant=tenant or "anon",
         )
         if guided is not None:
             seq.guided = self.guided.open(guided)  # ValueError on a bad spec
@@ -1209,6 +1236,11 @@ class Scheduler:
             kv_read_prefill=seq.num_computed,
             kv_read_decode=sum(s.total_len for s in batch),
         )
+        self._bill_step(
+            timer.dur,
+            [(seq, "prefill", len(chunk_tokens), seq.num_computed)]
+            + [(s, "decode", 1, s.total_len) for s in batch],
+        )
         self.telemetry.observe("itl", timer.dur)
         self._trace_event(
             seq, "mixed_ride", chunk_tokens=len(chunk_tokens), decode_rows=n,
@@ -1241,6 +1273,10 @@ class Scheduler:
             if seq.aborted:
                 self.waiting.remove(seq)
                 seq.state = SeqState.FINISHED
+                # Never-admitted requests still bill their queue time (and
+                # any mid-prefill KV hold) — a timeout storm in the queue is
+                # exactly what tenant attribution must see.
+                self._emit_bill(seq, seq.abort_reason)
                 # Mid-prefill cancellations already hold blocks — release them.
                 self.allocator.release(seq.block_ids)
                 seq.block_ids = []
@@ -1386,6 +1422,7 @@ class Scheduler:
                 seq.num_cached_blocks = 0
                 seq.num_computed = 0
                 seq.cached_tokens = 0
+                seq.kv_ts = None  # clock started at first touch; nothing held now
                 seq.state = SeqState.WAITING
             return False
 
@@ -1435,6 +1472,10 @@ class Scheduler:
         self.flight.record_step(
             "wave", timer.dur, int(valid.sum()) + len(admitted),
             kv_read_tokens=int(pos0.sum()),
+        )
+        self._bill_step(
+            timer.dur,
+            [(seq, "prefill", int(valid[i]) + 1, int(pos0[i])) for i, seq in enumerate(admitted)],
         )
         return True
 
@@ -1486,7 +1527,11 @@ class Scheduler:
             seq.num_cached_blocks = 0
             seq.num_computed = 0
             seq.cached_tokens = 0
+            seq.kv_ts = None
             raise
+        # Block-seconds clock starts at first hold — prefix-cache matched
+        # (COW-shared) blocks included, since the tenant pins their refcount.
+        self._accrue_kv(seq)
         seq.state = SeqState.PREFILL
         if seq.admitted_ts is None:
             seq.admitted_ts = time.monotonic()
@@ -1562,6 +1607,7 @@ class Scheduler:
         self.flight.record_step(
             "prefill", timer.dur, len(tokens), kv_read_tokens=seq.num_computed
         )
+        self._bill_step(timer.dur, [(seq, "prefill", len(tokens), seq.num_computed)])
         self._trace_event(
             seq, "prefill_chunk", tokens=len(tokens), bucket=bucket,
             computed=seq.num_computed + len(tokens), dur_s=round(timer.dur, 6),
@@ -2148,6 +2194,7 @@ class Scheduler:
             "decode", timer.dur, len(pipe["batch"]),
             kv_read_tokens=sum(s.total_len for s in pipe["batch"]),
         )
+        self._bill_step(timer.dur, [(s, "decode", 1, s.total_len) for s in pipe["batch"]])
         self.telemetry.observe("itl", timer.dur)
         if finished:
             self._overlap_flush(outputs, rollback=rollback)
@@ -2281,6 +2328,7 @@ class Scheduler:
             "decode", timer.dur, len(outputs),
             kv_read_tokens=sum(s.total_len for s in batch),
         )
+        self._bill_step(timer.dur, [(s, "decode", 1, s.total_len) for s in batch])
         self.telemetry.observe("itl", timer.dur)
         return outputs
 
@@ -2518,6 +2566,7 @@ class Scheduler:
                 kv_read_tokens=sum(s.total_len for s in batch),
                 param_passes=1.0,
             )
+            self._bill_step(timer.dur, [(s, "decode", steps, s.total_len) for s in batch])
             self.telemetry.observe("itl", timer.dur / max(steps, 1))
             return True
 
@@ -2548,6 +2597,7 @@ class Scheduler:
             # The fori_loop window re-streams the parameter set every step.
             param_passes=float(steps),
         )
+        self._bill_step(timer.dur, [(s, "decode", steps, steps * s.total_len) for s in batch])
         self.telemetry.observe("itl", timer.dur / max(steps, 1))
         return True
 
@@ -2674,10 +2724,12 @@ class Scheduler:
             # inputs covered positions old_total..old_total+γ-2, of which the
             # first min(k, γ-1) carry accepted (confirmed) tokens.
             seq.d_n = old_total + min(k, gamma - 1)
+        dur_round = time.perf_counter() - t_round
         self.flight.record_step(
-            "spec", time.perf_counter() - t_round, len(outputs) - n0,
+            "spec", dur_round, len(outputs) - n0,
             kv_read_tokens=2 * sum(s.total_len for s in batch),
         )
+        self._bill_step(dur_round, [(s, "decode", S, 2 * s.total_len) for s in batch])
         return True
 
     # --- disaggregation support ---------------------------------------------
@@ -2698,6 +2750,7 @@ class Scheduler:
         full = n_pref >= len(seq.prompt)
         n_blocks = (len(seq.prompt) + 1 + bs - 1) // bs
         seq.block_ids = self.allocator.allocate(n_blocks)  # raises → retried next step
+        self._accrue_kv(seq)  # decode leg's block-seconds clock starts at injection
         if "device_blocks" in data:
             k_stack, v_stack = data["device_blocks"]
             scatter_blocks_device(self.cache, seq.block_ids[: k_stack.shape[1]], k_stack, v_stack)
@@ -2910,6 +2963,10 @@ class Scheduler:
             return False
         victim = max(candidates, key=lambda s: s.arrival_ts)
         self.running.remove(victim)
+        # Close the victim's KV accrual at the true release point: it holds
+        # no blocks while waiting for recompute, so its clock stops here.
+        self._accrue_kv(victim)
+        victim.kv_ts = None
         self.allocator.release(victim.block_ids)
         victim.block_ids = []
         victim.block_hashes = []
@@ -3101,6 +3158,96 @@ class Scheduler:
         if n_full > seq.num_cached_blocks:
             self.allocator.register_hashes(seq.block_ids[:n_full], seq.block_hashes[:n_full])
 
+    # --- tenant capacity billing (runtime/ledger.py) ------------------------
+
+    def _measured_mult(self) -> float:
+        """Wall→device-seconds multiplier from the continuous profiler:
+        ``measured_modeled_mfu_ratio`` is modeled/measured (= step_s /
+        device_s), so device-seconds per wall second is its inverse.
+        Clamped to a sane band so one noisy window can't distort bills;
+        1.0 until a measured window lands."""
+        snap = self.flight.measured_snapshot()
+        if not snap:
+            return 1.0
+        r = float(snap.get("measured_modeled_mfu_ratio") or 0.0)
+        if r <= 0.0:
+            return 1.0
+        return min(4.0, max(0.25, 1.0 / r))
+
+    def _bill_step(self, dur_s: float, rows: List[tuple]) -> None:
+        """Charge one step's wall time to its rows' bills. ``rows`` is
+        [(seq, phase, tokens, kv_read_tokens)]; each row's share is its
+        MARGINAL roofline weight from the step cost model (its flops +
+        its KV traffic; the parameter read is batch-shared, so it's
+        excluded from attribution), normalized so shares sum to dur_s
+        exactly — per-step conservation — then scaled to device-seconds
+        by the measured/modeled ratio when the continuous profiler has a
+        live window. Also the per-step KV block-second accrual point."""
+        if dur_s <= 0.0 or not rows:
+            return
+        cm = self.flight.cost_model
+        weights: List[float] = []
+        flops_rows: List[float] = []
+        for _seq, _phase, tokens, kv_read in rows:
+            if cm is not None:
+                fl = cm.flops_per_token * tokens
+                by = (kv_read * cm.kv_read_factor + tokens) * cm.kv_bytes_per_token
+                w = max(fl / cm.peak_flops, by / cm.peak_bw)
+            else:
+                fl = 0.0
+                w = float(max(tokens, 1))
+            weights.append(max(w, 1e-12))
+            flops_rows.append(fl)
+        scale = dur_s * self._measured_mult() / sum(weights)
+        now = time.monotonic()
+        for (seq, phase, _tokens, _kv), w, fl in zip(rows, weights, flops_rows):
+            if phase == "prefill":
+                seq.bill_prefill_s += w * scale
+            else:
+                seq.bill_decode_s += w * scale
+            seq.bill_flops += fl
+            self._accrue_kv(seq, now)
+
+    def _accrue_kv(self, seq: Sequence, now: Optional[float] = None) -> None:
+        """Lazy KV block-second accrual: charge the blocks held since the
+        last accrual point (step billing, preemption, finish). COW-shared
+        prefix blocks sit in ``block_ids`` like any other, so every holder
+        pays for the blocks it pins. Block-count growth mid-interval is
+        charged at the new count for ≤ one step — negligible and cheap."""
+        if now is None:
+            now = time.monotonic()
+        if seq.kv_ts is not None:
+            seq.bill_kv_block_s += len(seq.block_ids) * (now - seq.kv_ts)
+        seq.kv_ts = now if seq.block_ids else None
+
+    def _emit_bill(self, seq: Sequence, reason: str,
+                   ttft_s: Optional[float] = None,
+                   tpot_s: Optional[float] = None) -> None:
+        """Emit the request's RequestBill into the tenant ledger — the ONE
+        choke point (finish, timeout eviction, abort reap), guarded so a
+        request can never bill twice on one worker. A migrated or disagg
+        request's other leg bills on ITS worker's ledger, so legs sum
+        across the fleet without double-billing. Must run while the
+        sequence still holds its blocks (the final KV accrual)."""
+        if seq.billed:
+            return
+        seq.billed = True
+        self._accrue_kv(seq)
+        queue_end = seq.admitted_ts if seq.admitted_ts is not None else time.monotonic()
+        self.ledger.record(RequestBill(
+            tenant=seq.tenant,
+            request_id=seq.request_id,
+            queue_s=max(0.0, queue_end - seq.arrival_ts),
+            prefill_device_s=seq.bill_prefill_s,
+            decode_device_s=seq.bill_decode_s,
+            flops=seq.bill_flops,
+            output_tokens=len(seq.output_ids),
+            kv_block_s=seq.bill_kv_block_s,
+            finish_reason=reason,
+            ttft_s=ttft_s,
+            tpot_s=tpot_s,
+        ))
+
     def _finish(self, seq: Sequence, reason: str, outputs: List[tuple], emit: bool = True) -> None:
         if seq in self.running:
             self.running.remove(seq)
@@ -3108,15 +3255,18 @@ class Scheduler:
         # Request-level telemetry + the SLO/goodput verdict. Cancelled and
         # errored requests are not judged (the client walked away; counting
         # them as violations would let an abort storm fake an SLO breach).
+        ttft_s = tpot_s = None
         if seq.first_token_ts is not None and reason in ("stop", "length"):
             now = time.monotonic()
             ttft_s = max(0.0, seq.first_token_ts - seq.arrival_ts)
             n_out = len(seq.output_ids)
-            tpot_s = None
             if n_out > 1:
                 tpot_s = max(0.0, now - seq.first_token_ts) / (n_out - 1)
                 self.telemetry.observe("tpot", tpot_s)
             self.slo.judge(ttft_s, tpot_s, n_out)
+        # Tenant ledger: the request's capacity bill, emitted while blocks
+        # are still held so the KV accrual closes at the true release point.
+        self._emit_bill(seq, reason, ttft_s=ttft_s, tpot_s=tpot_s)
         self._trace_event(
             seq, "finish", reason=reason, output_tokens=len(seq.output_ids),
             preemptions=seq.preemptions,

@@ -587,6 +587,10 @@ class HttpService:
         try:
             body = oai.validate_chat_request(body) if kind == "chat" else oai.validate_completion_request(body)
             model = body["model"]
+            # Capacity-ledger attribution: resolve the tenant once, here,
+            # so the preprocessor can put it on the wire and every usage
+            # block echoes the id the request was billed under.
+            body["_tenant"] = _resolve_tenant(body, request.headers)
         except oai.RequestError as e:
             self._m_requests(model, "400").inc()
             return web.json_response(oai.error_body(str(e)), status=400)
@@ -631,7 +635,7 @@ class HttpService:
         # decision repeats in the worker and scheduler.
         span = get_tracer().span_from(
             "http_request", ctx.traceparent, service="frontend",
-            model=model, kind=kind, stream=stream,
+            model=model, kind=kind, stream=stream, tenant=body["_tenant"],
         )
         if span is not NULL_SPAN:
             ctx.traceparent = span.child_traceparent()
@@ -665,14 +669,15 @@ class HttpService:
                         )
 
     def _timeout_response(self, ctx, model, prompt_tokens, completion_tokens,
-                          cached_tokens=None) -> web.Response:
+                          cached_tokens=None, tenant=None) -> web.Response:
         """504 with partial-usage accounting: the tokens that did stream are
         real work the client may be billed for, and the counts tell the
         operator how close the request got before the deadline."""
         self._m_timeouts(model).inc()
         self._m_requests(model, "504").inc()
         body = oai.error_body("request deadline exceeded", "timeout_error", 504)
-        body["usage"] = oai.usage_dict(prompt_tokens, completion_tokens, cached_tokens)
+        body["usage"] = oai.usage_dict(prompt_tokens, completion_tokens, cached_tokens,
+                                       tenant=tenant)
         return web.json_response(body, status=504, headers=_trace_headers(ctx))
 
     def _failure_response(self, e, ctx, model, prompt_tokens, completion_tokens):
@@ -850,7 +855,8 @@ class HttpService:
             # Deadline expiry — engine-evicted (finish_reason "timeout") or
             # the frontend watchdog above. 504 with partial-usage accounting.
             return self._timeout_response(ctx, model, prompt_tokens_box[0],
-                                          sum(tokens_box), cached_tokens_box[0])
+                                          sum(tokens_box), cached_tokens_box[0],
+                                          tenant=body.get("_tenant"))
         self._m_requests(model, "200").inc()
         total_tokens = sum(r["n_tokens"] for r in results)
         self._m_output_tokens(model).inc(total_tokens)
@@ -859,7 +865,7 @@ class HttpService:
         )
         usage = oai.usage_dict(
             prompt_tokens=prompt_tokens_box[0], completion_tokens=total_tokens,
-            cached_tokens=cached_tokens_box[0],
+            cached_tokens=cached_tokens_box[0], tenant=body.get("_tenant"),
         )
         if kind == "chat":
             choices = [
@@ -926,6 +932,8 @@ class HttpService:
         first_at = None
         prev_tok_at = None
         n_tokens = 0
+        prompt_tokens = 0
+        cached_tokens = None
         status = "200"
         try:
             if kind == "chat":
@@ -934,11 +942,13 @@ class HttpService:
                 if isinstance(item, Annotated) and item.is_annotation():
                     if item.event.startswith("_"):
                         if item.event == "_metrics":
-                            self._m_input_tokens(model).inc(int(item.comment or 0))
+                            prompt_tokens = int(item.comment or 0)
+                            self._m_input_tokens(model).inc(prompt_tokens)
                         elif item.event == "_queue":
                             self._m_queue(model).observe(float(item.comment or 0))
                         elif item.event == "_cached":
-                            self._m_cached_tokens(model).inc(int(item.comment or 0))
+                            cached_tokens = int(item.comment or 0)
+                            self._m_cached_tokens(model).inc(cached_tokens)
                         continue
                     await _sse_event(resp, item.event, item.comment)
                     continue
@@ -985,11 +995,21 @@ class HttpService:
                         # the status counter.
                         status = "504"
                         self._m_timeouts(model).inc()
+                    # Final frame carries the usage block (OpenAI
+                    # stream_options include_usage shape) with the resolved
+                    # tenant echoed — the client sees who it was billed as.
+                    usage = oai.usage_dict(
+                        prompt_tokens, n_tokens, cached_tokens,
+                        tenant=body.get("_tenant"),
+                    )
                     chunk = (
-                        oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason)
+                        oai.chat_chunk(rid, model, {}, finish_reason=out.finish_reason,
+                                       usage=usage)
                         if kind == "chat"
                         else oai.completion_chunk(rid, model, "", finish_reason=out.finish_reason)
                     )
+                    if kind != "chat":
+                        chunk["usage"] = usage
                     await _sse(resp, chunk)
         except (ConnectionResetError, asyncio.CancelledError):
             # Client went away: cancel into the pipeline (ref: disconnect.rs).
@@ -1167,6 +1187,29 @@ _as_output = as_engine_output
 # and ``tools/trace_view.py`` — even for unsampled requests, where it still
 # correlates with the structured logs.
 TRACE_ID_HEADER = "x-dynamo-trace-id"
+
+# Capacity-ledger tenant attribution (runtime/ledger.py). Resolution order:
+# the OpenAI ``user`` field, then this header, then a hash of the API key —
+# "anon" only when the request carries nothing attributable.
+TENANT_HEADER = "x-dynamo-tenant"
+
+
+def _resolve_tenant(body: dict, headers) -> str:
+    user = body.get("user")
+    if user:
+        return oai.validate_tenant(user, "user")
+    hdr = headers.get(TENANT_HEADER)
+    if hdr:
+        return oai.validate_tenant(hdr, TENANT_HEADER)
+    auth = headers.get("Authorization") or ""
+    if auth:
+        # Stable pseudonymous id per API key: attribution without storing
+        # (or ever re-emitting) the credential itself.
+        import hashlib
+
+        token = auth.split(None, 1)[-1]
+        return "key-" + hashlib.sha256(token.encode()).hexdigest()[:16]
+    return "anon"
 
 
 def _trace_headers(ctx: Context) -> dict:

@@ -72,6 +72,9 @@ class MockEngineArgs:
     # stats keys, so planner tests and traffic harnesses run engine-free.
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # Tenant ledger (runtime/ledger.py): heavy-hitter sketch width, same
+    # knob as SchedulerConfig.ledger_top_k.
+    ledger_top_k: int = 16
     # Output-token rule: "cycle" repeats the prompt (default), "position"
     # emits token = sequence position — position streams continue bit-
     # identically across a migration replay (prompt + emitted tokens fold
@@ -110,8 +113,10 @@ class _Seq:
         deadline_ms: Optional[float] = None,
         prefill_done: bool = False,
         prefill_len: Optional[int] = None,
+        tenant: str = "anon",
     ):
         self.request_id = request_id
+        self.tenant = tenant
         self.tokens = tokens
         self.max_tokens = max_tokens
         self.context = context
@@ -141,6 +146,14 @@ class _Seq:
         self.recompute = 0  # generated tokens whose KV must be recomputed (preemption)
         self.preemptions = 0
         self.done = False
+        # Tenant capacity bill (runtime/ledger.py) — same accrual discipline
+        # as the real scheduler's Sequence: simulated device-seconds per
+        # phase, lazy KV block-second clock, billed-once guard.
+        self.bill_prefill_s = 0.0
+        self.bill_decode_s = 0.0
+        self.bill_kv_block_s = 0.0
+        self.kv_ts: Optional[float] = None
+        self.billed = False
 
     @property
     def total_len(self) -> int:
@@ -223,6 +236,15 @@ class MockTpuEngine:
         self.telemetry = Telemetry()
         self.slo = SloJudge(SloConfig(ttft_ms=self.args.slo_ttft_ms,
                                       tpot_ms=self.args.slo_tpot_ms))
+        # Tenant capacity ledger: same sketch/digest/stats surface as the
+        # real scheduler's, fed from the simulated timing model, so fleet
+        # merge and Grafana's Tenants row run engine-free.
+        from dynamo_tpu.runtime.ledger import TenantLedger
+
+        self.ledger = TenantLedger(
+            top_k=self.args.ledger_top_k,
+            slo=SloConfig(ttft_ms=self.args.slo_ttft_ms, tpot_ms=self.args.slo_tpot_ms),
+        )
         # Incident autopsy plane (runtime/incidents.py): the mocker runs the
         # REAL detector over its own simulated stats and emits the same
         # incidents_*/gauge keys as TpuEngine, so planner/autoscaler stacks
@@ -307,6 +329,7 @@ class MockTpuEngine:
             f"mock-{self.request_total}", tokens, max_tokens, context,
             forced=forced, deadline_ms=float(deadline_ms) if deadline_ms else None,
             prefill_done=prefilled, prefill_len=prefill_len,
+            tenant=request.get("tenant") or "anon",
         )
         self.waiting.append(seq)
         self._ensure_loop()
@@ -384,6 +407,7 @@ class MockTpuEngine:
             # parked while the head can't allocate is a head-of-line
             # deadlock); otherwise take the head.
             wave_tokens = 0
+            wave_bill: List[tuple] = []  # (seq, chunk) — per-seq prefill attribution
             while (
                 self.waiting
                 and len(self.running) < args.max_batch
@@ -393,6 +417,8 @@ class MockTpuEngine:
                 chunk = self._admit_chunk(seq, args.max_prefill_chunk - wave_tokens)
                 wave_tokens += chunk
                 self.prefill_tokens_done += chunk
+                if chunk:
+                    wave_bill.append((seq, chunk))
                 if seq.in_decode:
                     # remove() not pop(0): _admit_chunk's allocation may have
                     # preempted a victim INTO waiting[0] just now.
@@ -428,10 +454,30 @@ class MockTpuEngine:
                 self.step_prefill_steps_total += 1
                 self.step_prefill_tokens_total += wave_tokens
                 self.step_prefill_time_s += pre_ms * scale
+                # Tenant billing: the wave's simulated prefill time splits
+                # pro-rata by chunk tokens — shares sum to the step exactly.
+                for s, chunk in wave_bill:
+                    s.bill_prefill_s += pre_ms * scale * (chunk / wave_tokens)
             if decoding:
                 self.step_decode_steps_total += 1
                 self.step_decode_tokens_total += len(decoding)
                 self.step_decode_time_s += dec_ms * scale
+                # Decode billing: each row's marginal term of the timing
+                # model (per-seq + per-KV-token), normalized so the shared
+                # weights-streaming floor is carried pro-rata too.
+                dweights = [
+                    args.itl_per_seq_ms + s.total_len * args.itl_per_kv_token_us / 1000.0
+                    for s in decoding
+                ]
+                dsum = sum(dweights) or 1.0
+                for s, w in zip(decoding, dweights):
+                    s.bill_decode_s += dec_ms * scale * w / dsum
+            # KV block-second accrual for every current holder (lazy clock,
+            # same discipline as the real scheduler's _accrue_kv).
+            kv_now = time.monotonic()
+            for s in self.running + self.waiting:
+                if s.block_ids or s.kv_ts is not None:
+                    self._accrue_kv(s, kv_now)
             if decoding:
                 # Wall-clock step time = the ITL the wire observes.
                 self.telemetry.observe("itl", step_ms / 1000.0 / args.speedup_ratio)
@@ -447,7 +493,7 @@ class MockTpuEngine:
                 if s.forced is not None and not s.forced:
                     # Grammar accepts the empty string: finish immediately.
                     s.out.put_nowait({"token_ids": [], "finish_reason": "stop", "index": 0})
-                    self._finish(s)
+                    self._finish(s, "stop")
                     continue
                 s.generated += 1
                 self.output_tokens_total += 1
@@ -483,15 +529,15 @@ class MockTpuEngine:
                 if finish:
                     # Natural finish: judge SLA (cancelled requests aren't
                     # latency violations) and fold TPOT into the digests.
+                    ttft_s = tpot_s = None
                     if s.first_token_ts is not None:
                         now = time.monotonic()
                         ttft_s = max(0.0, s.first_token_ts - s.arrival_ts)
-                        tpot_s = None
                         if s.generated > 1:
                             tpot_s = max(0.0, now - s.first_token_ts) / (s.generated - 1)
                             self.telemetry.observe("tpot", tpot_s)
                         self.slo.judge(ttft_s, tpot_s, s.generated)
-                    self._finish(s)
+                    self._finish(s, finish, ttft_s=ttft_s, tpot_s=tpot_s)
             if not (self.waiting or self.running):
                 # Wait briefly for new arrivals before exiting the loop task.
                 self._wake.clear()
@@ -522,13 +568,18 @@ class MockTpuEngine:
             if reason is not None:
                 if not s.done:
                     s.out.put_nowait({"token_ids": [], "finish_reason": reason, "index": 0})
-                self._finish(s)
+                self._finish(s, reason)
         for s in list(self.waiting):
             reason = verdict(s)
             if reason is not None:
                 self.waiting.remove(s)
+                # Never-admitted requests still bill their queue time (and
+                # any mid-prefill KV hold) — timeout storms in the queue are
+                # exactly what tenant attribution must see.
+                self._emit_bill(s, reason)
                 self.allocator.release(s.block_ids)
                 s.block_ids = []
+                s.kv_ts = None
                 if not s.done:
                     s.out.put_nowait({"token_ids": [], "finish_reason": reason, "index": 0})
 
@@ -647,6 +698,9 @@ class MockTpuEngine:
     def _preempt(self, seq: _Seq) -> None:
         if seq in self.running:
             self.running.remove(seq)
+        # Close the KV clock at the true release point (recompute holds none).
+        self._accrue_kv(seq)
+        seq.kv_ts = None
         self.allocator.release(seq.block_ids)
         seq.block_ids = []
         seq.hashes = []
@@ -657,13 +711,50 @@ class MockTpuEngine:
         self.preempt_total += 1
         self.waiting.insert(0, seq)
 
-    def _finish(self, seq: _Seq) -> None:
+    def _finish(self, seq: _Seq, reason: str = "cancelled",
+                ttft_s: Optional[float] = None, tpot_s: Optional[float] = None) -> None:
         if seq in self.running:
             self.running.remove(seq)
         if seq in self.waiting:
             self.waiting.remove(seq)
+        # Bill while blocks are still held so the KV accrual closes at the
+        # true release point — same choke-point discipline as the scheduler.
+        self._emit_bill(seq, reason, ttft_s=ttft_s, tpot_s=tpot_s)
         self.allocator.release(seq.block_ids)
         seq.block_ids = []
+        seq.kv_ts = None
+
+    def _accrue_kv(self, seq: _Seq, now: Optional[float] = None) -> None:
+        """Lazy KV block-second accrual (real scheduler's _accrue_kv)."""
+        if now is None:
+            now = time.monotonic()
+        if seq.kv_ts is not None:
+            seq.bill_kv_block_s += len(seq.block_ids) * (now - seq.kv_ts)
+        seq.kv_ts = now if seq.block_ids else None
+
+    def _emit_bill(self, seq: _Seq, reason: str,
+                   ttft_s: Optional[float] = None,
+                   tpot_s: Optional[float] = None) -> None:
+        if seq.billed:
+            return
+        seq.billed = True
+        from dynamo_tpu.runtime.ledger import RequestBill
+
+        self._accrue_kv(seq)
+        queue_end = seq.admitted_ts if seq.admitted_ts is not None else time.monotonic()
+        self.ledger.record(RequestBill(
+            tenant=seq.tenant,
+            request_id=seq.request_id,
+            queue_s=max(0.0, queue_end - seq.arrival_ts),
+            prefill_device_s=seq.bill_prefill_s,
+            decode_device_s=seq.bill_decode_s,
+            flops=0.0,  # the mocker has no cost model — device time is the truth
+            output_tokens=seq.generated,
+            kv_block_s=seq.bill_kv_block_s,
+            finish_reason=reason,
+            ttft_s=ttft_s,
+            tpot_s=tpot_s,
+        ))
 
     def _crash_all(self) -> None:
         """Injected engine death: sever every live stream without a final
@@ -675,6 +766,7 @@ class MockTpuEngine:
         for s in self.running + self.waiting:
             self.allocator.release(s.block_ids)
             s.block_ids = []
+            s.kv_ts = None  # process death: in-flight consumption bills nowhere
             s.out.put_nowait(_CRASH)
         self.running.clear()
         self.waiting.clear()
@@ -784,6 +876,11 @@ class MockTpuEngine:
         # can run against pure mocker fleets.
         stats.update(self.slo.to_stats())
         stats["digests"] = self.telemetry.to_wire()
+        # Tenant ledger: identical flat tenant_* keys + sketch wire as the
+        # real engine's scrape, so the aggregator's fleet merge and the
+        # Grafana Tenants row run against mocker fleets unchanged.
+        stats.update(self.ledger.to_stats())
+        stats["tenant_ledger"] = self.ledger.to_wire()
         # Incident plane: same detector, same incidents_*/profiler keys as
         # the real engine's scrape (engine-free planner stacks included).
         self.incidents.observe(stats)

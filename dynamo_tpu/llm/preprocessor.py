@@ -170,4 +170,7 @@ class OpenAIPreprocessor(Operator):
             router_overrides=nvext.get("router") or {},
             image_urls=image_urls,
             guided_decoding=guided,
+            # Resolved by the frontend (http/service.py _resolve_tenant);
+            # raw `user` is the fallback so non-HTTP entry points still bill.
+            tenant=body.get("_tenant") or body.get("user") or "anon",
         ), prompt

@@ -69,6 +69,10 @@ class PreprocessedRequest:
     # (llm/guided). Built by the preprocessor from response_format /
     # tool_choice / nvext guided_* — the wire stays text-free.
     guided_decoding: Optional[Dict[str, Any]] = None
+    # Capacity-ledger attribution: resolved by the frontend (`user` field →
+    # x-dynamo-tenant header → API-key hash → "anon") and billed by the
+    # worker scheduler (runtime/ledger.py).
+    tenant: str = "anon"
 
     def to_wire(self) -> dict:
         d = {
@@ -79,6 +83,7 @@ class PreprocessedRequest:
             "model": self.model,
             "router_overrides": self.router_overrides,
             "disagg_params": self.disagg_params,
+            "tenant": self.tenant,
         }
         if self.image_urls:
             d["_mm_image_urls"] = self.image_urls
@@ -97,6 +102,7 @@ class PreprocessedRequest:
             router_overrides=d.get("router_overrides") or {},
             disagg_params=d.get("disagg_params") or {},
             guided_decoding=d.get("guided_decoding"),
+            tenant=d.get("tenant") or "anon",
         )
 
 

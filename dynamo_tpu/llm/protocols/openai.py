@@ -11,6 +11,7 @@ under the same key: ``{"nvext": {"annotations": [...], "router": {...}}}``.
 
 from __future__ import annotations
 
+import re
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -23,6 +24,30 @@ class RequestError(ValueError):
 def _require(cond: bool, msg: str) -> None:
     if not cond:
         raise RequestError(msg)
+
+
+# Tenant ids feed the capacity ledger's heavy-hitter sketches and come back
+# out as Prometheus label values and Grafana legends — cap length and
+# charset so an abusive `user` field can't explode label cardinality per
+# byte or smuggle control characters into dashboards.
+TENANT_MAX_LEN = 64
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._:-]+$")
+
+
+def validate_tenant(value: Any, source: str = "user") -> str:
+    """Validate a client-supplied tenant id (OpenAI ``user`` field or the
+    ``x-dynamo-tenant`` header). Returns the id; raises a structured 400
+    on abuse."""
+    _require(isinstance(value, str) and bool(value), f"{source} must be a non-empty string")
+    _require(
+        len(value) <= TENANT_MAX_LEN,
+        f"{source} must be at most {TENANT_MAX_LEN} characters",
+    )
+    _require(
+        _TENANT_RE.match(value) is not None,
+        f"{source} may only contain [A-Za-z0-9._:-]",
+    )
+    return value
 
 
 def validate_chat_request(body: dict) -> dict:
@@ -164,6 +189,9 @@ def _validate_common_sampling(body: dict) -> None:
     )
     seed = body.get("seed")
     _require(seed is None or isinstance(seed, int), "seed must be an integer")
+    user = body.get("user")
+    if user is not None:
+        validate_tenant(user, "user")
     lb = body.get("logit_bias")
     if lb is not None:
         _require(isinstance(lb, dict), "logit_bias must be an object mapping token ids to bias")
@@ -446,11 +474,15 @@ def completion_response(
 
 
 def usage_dict(
-    prompt_tokens: int, completion_tokens: int, cached_tokens: Optional[int] = None
+    prompt_tokens: int,
+    completion_tokens: int,
+    cached_tokens: Optional[int] = None,
+    tenant: Optional[str] = None,
 ) -> dict:
     """OpenAI usage block. ``cached_tokens`` (engine-reported prefix-cache
     reuse) renders as ``prompt_tokens_details.cached_tokens`` when known —
-    the OpenAI prompt-caching wire shape."""
+    the OpenAI prompt-caching wire shape. ``tenant`` echoes the resolved
+    tenant id the capacity ledger billed this request under."""
     out = {
         "prompt_tokens": prompt_tokens,
         "completion_tokens": completion_tokens,
@@ -458,6 +490,8 @@ def usage_dict(
     }
     if cached_tokens is not None:
         out["prompt_tokens_details"] = {"cached_tokens": int(cached_tokens)}
+    if tenant is not None:
+        out["tenant"] = tenant
     return out
 
 

@@ -71,6 +71,9 @@ GAUGE_KEYS = (
     # Profile-derived capacity: EMA of measured per-worker tok/s the
     # autoscale controller is currently steering on (0 until warm).
     "planner_measured_prefill_tok_s", "planner_measured_decode_tok_s",
+    # Tenant capacity ledger (runtime/ledger.py): tenants currently tracked
+    # by the worker's device-seconds heavy-hitter sketch (≤ top_k).
+    "tenant_tracked",
 )
 
 # Fleet-level digest families the aggregator re-exports (merged across
@@ -161,7 +164,22 @@ COUNTER_KEYS = (
     "measured_windows_total", "measured_device_seconds_total",
     "measured_wall_seconds_total",
     "profiler_capture_conflicts_total",
+    # Tenant capacity ledger: per-worker exact billed totals (unlabeled —
+    # the labeled per-tenant families are fleet-side, built from the merged
+    # sketch wire in _export_tenant_families).
+    "tenant_billed_device_seconds_total", "tenant_billed_kv_block_seconds_total",
+    "tenant_billed_queue_seconds_total", "tenant_billed_output_tokens_total",
+    "tenant_bills_total", "tenant_slo_attained_total", "tenant_slo_violated_total",
 )
+
+# Fleet-merged per-tenant counter families: top-K tenants by label plus an
+# ``other`` bucket so Σ labeled series ≈ the fleet's exact billed total
+# (the SpaceSaving over-count bias lands in the clamped ``other``).
+TENANT_FAMILY_BY_DIM = {
+    "device_seconds": "tenant_device_seconds_total",
+    "kv_block_seconds": "tenant_kv_block_seconds_total",
+    "queue_seconds": "tenant_queue_seconds_total",
+}
 
 
 class MetricsAggregator:
@@ -208,6 +226,10 @@ class MetricsAggregator:
         self.client = None
         # Last-seen totals per (worker, key) for Counter delta export.
         self._last: dict = {}
+        # Latest tenant-ledger wire per worker (kept across scrapes so a
+        # briefly-missed worker doesn't re-count its history when it
+        # reappears); merged fleet-wide each scrape.
+        self._tenant_wires: dict = {}
 
     async def start(self) -> None:
         ep = self.drt.namespace(self.namespace).component(self.component).endpoint(self.endpoint_name)
@@ -243,6 +265,12 @@ class MetricsAggregator:
         self.digests.update_from_wire(
             s.get("digests") for s in stats.values() if isinstance(s.get("digests"), dict)
         )
+        # Tenant ledger: fold each worker's sketch wire and export the
+        # fleet-merged labeled families (delta-per-scrape, like counters).
+        for wid, s in stats.items():
+            if isinstance(s.get("tenant_ledger"), dict):
+                self._tenant_wires[wid] = s["tenant_ledger"]
+        self._export_tenant_families()
         # Fleet-level anomaly check: a shrinking instance set fires
         # worker_lost and captures a bundle with the per-worker scrape
         # summary + registered evidence (router decisions) attached.
@@ -266,6 +294,38 @@ class MetricsAggregator:
             prev = self._last.get(("fleet", key))
             c.inc(cur if prev is None else max(cur - prev, 0.0))
             self._last[("fleet", key)] = cur
+
+    def _export_tenant_families(self) -> None:
+        """Merge per-worker tenant-ledger wires into fleet-true top-K
+        sketches and export labeled counter families: per-tenant
+        device/KV-block/queue seconds (plus ``other`` so totals conserve)
+        and per-tenant/per-phase SLO verdicts. Cumulative merged values
+        diff against the last scrape (clamped ≥ 0 — sketch estimates may
+        wobble when the merged top-K set shifts)."""
+        from dynamo_tpu.runtime.ledger import TenantFleet, attribute
+
+        merged = TenantFleet().merge(self._tenant_wires.values())
+        if not merged:
+            return
+
+        def inc_delta(family: str, value: float, **labels) -> None:
+            c = self.registry.counter(family, f"fleet per-tenant {family}", **labels)
+            key = ("tenant", family, tuple(sorted(labels.items())))
+            prev = self._last.get(key)
+            c.inc(float(value) if prev is None else max(float(value) - prev, 0.0))
+            self._last[key] = float(value)
+
+        att = attribute(merged)
+        for dim, family in TENANT_FAMILY_BY_DIM.items():
+            d = att.get(dim) or {}
+            for row in d.get("tenants") or []:
+                inc_delta(family, row["value"], tenant=row["tenant"])
+            inc_delta(family, d.get("other") or 0.0, tenant="other")
+        for tenant, counts in (merged.get("slo") or {}).items():
+            for kind, family in (("violated", "tenant_slo_violated_total"),
+                                 ("attained", "tenant_slo_attained_total")):
+                for phase, n in (counts.get(kind) or {}).items():
+                    inc_delta(family, n, tenant=tenant, phase=phase)
 
     async def scrape_once(self) -> dict:
         """One merged scrape across the primary + extra endpoints (worker

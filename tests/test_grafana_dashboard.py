@@ -11,9 +11,17 @@ from dynamo_tpu.metrics_aggregator import (
     DIGEST_KEYS,
     FLEET_DIGEST_PREFIX,
     GAUGE_KEYS,
+    TENANT_FAMILY_BY_DIM,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Fleet-merged per-tenant counter families the aggregator exports from the
+# merged ledger sketches (labeled by tenant, plus tenant+phase for SLO).
+TENANT_FLEET_FAMILIES = set(TENANT_FAMILY_BY_DIM.values()) | {
+    "tenant_slo_violated_total",
+    "tenant_slo_attained_total",
+}
 
 
 def _component_families():
@@ -32,6 +40,9 @@ def _component_families():
     for key in DIGEST_KEYS:
         fams.add(f"{FLEET_DIGEST_PREFIX}{key}_seconds")
         fams.add(f"{FLEET_DIGEST_PREFIX}{key}_seconds_quantile")
+    # Fleet-merged per-tenant families (MetricsAggregator._export_tenant_families).
+    for key in TENANT_FLEET_FAMILIES:
+        fams.add(f"dynamo_component_{key}")
     return fams
 
 
@@ -74,5 +85,6 @@ def test_dashboard_counters_use_rate_friendly_names():
                 rated.add(m)
     assert rated, "dashboard should rate() at least one worker counter"
     counter_fams = {f"dynamo_component_worker_{k}" for k in COUNTER_KEYS}
+    counter_fams |= {f"dynamo_component_{k}" for k in TENANT_FLEET_FAMILIES}
     for fam in rated:
         assert fam in counter_fams, f"{fam} is rate()d but not exported as a Counter"

@@ -72,17 +72,24 @@ def frontend_registry() -> MetricsRegistry:
 
 def aggregator_registry() -> MetricsRegistry:
     """MetricsAggregator's registry fed one full scrape covering every
-    gauge and counter key a worker can report, plus a digest payload so
-    the fleet digest re-export families render too."""
+    gauge and counter key a worker can report, plus a digest payload and a
+    tenant-ledger wire so the fleet digest re-exports and the labeled
+    per-tenant families render too."""
     from dynamo_tpu.metrics_aggregator import DIGEST_KEYS
-    from dynamo_tpu.runtime.telemetry import Telemetry
+    from dynamo_tpu.runtime.ledger import RequestBill, TenantLedger
+    from dynamo_tpu.runtime.telemetry import SloConfig, Telemetry
 
     telem = Telemetry()
     for name in DIGEST_KEYS:
         telem.observe(name, 0.1)
+    ledger = TenantLedger(top_k=4, slo=SloConfig(ttft_ms=100.0, tpot_ms=10.0))
+    ledger.record(RequestBill(tenant="hygiene", prefill_device_s=0.1,
+                              decode_device_s=0.2, kv_block_s=1.0, queue_s=0.01,
+                              output_tokens=8, ttft_s=0.05, tpot_s=0.2))
     agg = MetricsAggregator(drt=None, namespace="ns", component="backend", endpoint="generate")
     stats = {0xA: {**{key: 1.0 for key in GAUGE_KEYS + COUNTER_KEYS},
-                   "digests": telem.to_wire()}}
+                   "digests": telem.to_wire(),
+                   "tenant_ledger": ledger.to_wire()}}
     agg.export_stats(stats)
     agg.export_stats(stats)  # second scrape exercises the delta path
     return agg.registry
@@ -233,6 +240,57 @@ def test_prefix_cache_metrics_render_in_all_roles():
         assert fams.get(f"dynamo_component_worker_{key}", {}).get("type") == "counter", (
             f"{key} not rendered as a counter by the aggregator"
         )
+
+
+def test_tenant_ledger_metrics_render_in_all_roles():
+    """Tenant capacity accounting must flow scheduler/mocker →
+    stats scrape → aggregator → Prometheus: the flat worker keys are in
+    COUNTER_KEYS/GAUGE_KEYS and on the mocker's scrape dict (with the
+    nested sketch wire), and the aggregator renders both the worker
+    counters and the fleet-merged LABELED per-tenant families."""
+    from dynamo_tpu.llm.mocker import MockTpuEngine
+    from dynamo_tpu.metrics_aggregator import TENANT_FAMILY_BY_DIM
+
+    flat_counters = (
+        "tenant_billed_device_seconds_total", "tenant_billed_kv_block_seconds_total",
+        "tenant_billed_queue_seconds_total", "tenant_billed_output_tokens_total",
+        "tenant_bills_total", "tenant_slo_attained_total", "tenant_slo_violated_total",
+    )
+    for key in flat_counters:
+        assert key in COUNTER_KEYS, f"{key} missing from aggregator COUNTER_KEYS"
+    assert "tenant_tracked" in GAUGE_KEYS
+
+    # Mocker scrape parity: same flat keys + the nested sketch wire the
+    # real engine's stats_handler exports.
+    stats = MockTpuEngine().stats_handler()
+    for key in flat_counters + ("tenant_tracked",):
+        assert key in stats, f"{key} missing from mocker stats_handler"
+    wire = stats["tenant_ledger"]
+    assert set(wire["sketches"]) == {"device_seconds", "kv_block_seconds",
+                                     "queue_seconds"}
+
+    # Aggregator: worker counters render rate()-able, and the labeled
+    # fleet families carry the tenant label (plus phase for SLO).
+    text = aggregator_registry().render().decode()
+    fams = parse_families(text)
+    for key in flat_counters:
+        assert fams.get(f"dynamo_component_worker_{key}", {}).get("type") == "counter", (
+            f"{key} not rendered as a counter by the aggregator"
+        )
+    for fam in set(TENANT_FAMILY_BY_DIM.values()) | {"tenant_slo_attained_total",
+                                                     "tenant_slo_violated_total"}:
+        assert fams.get(f"dynamo_component_{fam}", {}).get("type") == "counter", (
+            f"labeled fleet family {fam} not rendered as a counter"
+        )
+    assert 'tenant="hygiene"' in text and 'tenant="other"' in text
+    # The hygiene bill violates TPOT (200 ms vs a 10 ms target) and attains
+    # TTFT — both per-phase labeled samples must render, with the verdict.
+    slo_lines = [l for l in text.splitlines()
+                 if l.startswith("dynamo_component_tenant_slo_violated_total{")
+                 and 'tenant="hygiene"' in l]
+    by_phase = {("tpot" if 'phase="tpot"' in l else "ttft"): float(l.rsplit(" ", 1)[1])
+                for l in slo_lines}
+    assert by_phase == {"ttft": 0.0, "tpot": 1.0}
 
 
 def test_static_metrics_drift_dtlint_cross_check():

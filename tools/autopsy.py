@@ -258,12 +258,86 @@ def request_report(records: List[dict], trace_id: str,
     }
 
 
+# --- tenant attribution -------------------------------------------------------
+
+# Incident attribution → the ledger dimension that explains "who did it".
+_TENANT_DIMENSION = {
+    "queue_wait": "queue_seconds",
+    "prefill": "device_seconds",
+    "decode": "device_seconds",
+    "decode_host_gap": "device_seconds",
+    "compile": "device_seconds",
+    "stall": "device_seconds",
+}
+
+
+def tenant_report(bundle: dict) -> dict:
+    """Attribute an incident to tenants: join the bundle's tenant-ledger
+    evidence (runtime/ledger.py snapshot) with the window attribution, so
+    the report can say e.g. "queue_wait spike is 84% tenant X"."""
+    ledger = (bundle.get("evidence") or {}).get("tenant_ledger")
+    if not isinstance(ledger, dict) or "device_seconds" not in ledger:
+        # Older bundles (or a dead probe): fall back to the raw sketch wire
+        # riding the captured stats scrape.
+        wire = (bundle.get("stats") or {}).get("tenant_ledger")
+        if isinstance(wire, dict):
+            from dynamo_tpu.runtime.ledger import attribute
+
+            ledger = attribute(wire)
+        else:
+            return {"mode": "tenant",
+                    "error": "bundle carries no tenant ledger evidence"}
+
+    base = incident_report(bundle)
+    dim = _TENANT_DIMENSION.get(base["attribution"], "device_seconds")
+    ranked = (ledger.get(dim) or {}).get("tenants") or []
+    headline = None
+    if ranked:
+        top = ranked[0]
+        headline = (f"{base['reason']}: {dim.replace('_', ' ')} is "
+                    f"{100 * top['share']:.0f}% tenant '{top['tenant']}'")
+    return {
+        "mode": "tenant",
+        "reason": base["reason"],
+        "ts": bundle.get("ts"),
+        "attribution": base["attribution"],
+        "dimension": dim,
+        "headline": headline,
+        "bills": ledger.get("bills"),
+        "ledger": {k: ledger.get(k) for k in
+                   ("device_seconds", "kv_block_seconds", "queue_seconds")},
+        "slo": ledger.get("slo") or {},
+    }
+
+
 # --- rendering ---------------------------------------------------------------
 
 def render(report: dict, out=sys.stdout) -> None:
     mode = report.get("mode")
     if report.get("error"):
         out.write(f"autopsy: {report['error']}\n")
+        return
+    if mode == "tenant":
+        out.write(f"incident: {report['reason']}  (ts {report.get('ts')})\n")
+        out.write(f"attribution: {report['attribution'].upper()} "
+                  f"→ ledger dimension {report['dimension']}\n")
+        if report.get("headline"):
+            out.write(f"  {report['headline']}\n")
+        for dim, d in (report.get("ledger") or {}).items():
+            if not isinstance(d, dict):
+                continue
+            out.write(f"{dim} (total {d.get('total', 0.0):.3f}, "
+                      f"{report.get('bills', 0)} bills):\n")
+            for row in d.get("tenants") or []:
+                out.write(f"  {row['tenant']:<24} {row['value']:>12.4f} "
+                          f"{100 * row['share']:>6.1f}%  (±{row['error']:.4f})\n")
+            out.write(f"  {'<other>':<24} {d.get('other', 0.0):>12.4f} "
+                      f"{100 * d.get('other_share', 0.0):>6.1f}%\n")
+        for tenant, counts in (report.get("slo") or {}).items():
+            v = counts.get("violated") or {}
+            a = counts.get("attained") or {}
+            out.write(f"slo {tenant}: ttft {a.get('ttft', 0)}/{a.get('ttft', 0) + v.get('ttft', 0)} "
+                      f"attained, tpot {a.get('tpot', 0)}/{a.get('tpot', 0) + v.get('tpot', 0)} attained\n")
         return
     if mode == "incident":
         out.write(f"incident: {report['reason']}  (ts {report.get('ts')})\n")
@@ -304,6 +378,9 @@ def main() -> int:
                    help="incident bundle JSON files and/or JSONL trace files (merged)")
     p.add_argument("--request", default=None, metavar="TRACE_ID",
                    help="attribute one request instead of the incident window")
+    p.add_argument("--tenant", action="store_true",
+                   help="attribute the incident to tenants (capacity-ledger "
+                        "evidence: who consumed the device/KV/queue seconds)")
     p.add_argument("--json", action="store_true", help="emit the report as JSON")
     args = p.parse_args()
 
@@ -312,6 +389,11 @@ def main() -> int:
 
     if args.request:
         report = request_report(records, args.request, bundle=bundle)
+    elif args.tenant:
+        if bundle is None:
+            print("--tenant needs an incident bundle", file=sys.stderr)
+            return 2
+        report = tenant_report(bundle)
     elif bundle is not None:
         report = incident_report(bundle)
     else:
