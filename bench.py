@@ -278,6 +278,113 @@ def bench_decode_attention(cfg=None, params=None, ctx_len=1024, hbm_gbps=None):
     }
 
 
+def bench_fused_sampling():
+    """Fused in-kernel sampling + spec window section
+    (BENCH_FUSED_SAMPLE_ONLY): at b∈{8, 32}, sampled-fused (megakernel
+    window with the in-kernel top-k/top-p epilogue) vs sampled-multi (the
+    sync ``decode_multi`` window) tok/s, plus the fused spec window's
+    accepted-tokens/step. On CPU (interpreter-mode Pallas, CI) the numbers
+    are structural, not speed — the section's value there is the asserts:
+    sampled windows actually dispatch, the launch gauge holds 1 across
+    every fused variant, spec parity holds, and ≥2 tokens confirm per
+    spec round."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import get_config
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, StopConditions
+
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    on_cpu = jax.default_backend() != "tpu"
+
+    def run(impl: str, batch: int, *, draft: bool, greedy: bool = False,
+            max_tokens: int = 12):
+        sched = Scheduler(cfg.replace(attention_impl=impl), params, SchedulerConfig(
+            num_blocks=4 * batch + 32, max_running=batch,
+            prefill_buckets=[32], decode_buckets=[batch],
+            num_scheduler_steps=8, enable_prefix_caching=False,
+            enable_overlap_decode=False, enable_mixed_batching=False,
+        ), dtype=jnp.float32)
+        if draft:
+            sched.attach_draft(cfg, params, gamma=2)
+        sched.warmup(ctx_tokens=64)
+        sched.flight.mark_warmup_done(warmed=True)
+        toks: dict = {}
+        for i in range(batch):
+            sp = (SamplingParams(temperature=0.0) if draft or greedy else
+                  SamplingParams(temperature=0.8, top_k=20, top_p=0.9, seed=7 + i))
+            sched.add_request(f"r{i}", list(range(1 + i % 8, 25 + i % 8)), sp,
+                              StopConditions(max_tokens=max_tokens, ignore_eos=True))
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(400):
+            if not sched.has_work():
+                break
+            sched_out = sched.step()
+            steps += 1
+            for s, o in sched_out:
+                if o.token_id >= 0:
+                    toks.setdefault(s.request_id, []).append(o.token_id)
+        wall = time.perf_counter() - t0
+        n = sum(len(v) for v in toks.values())
+        assert n == batch * max_tokens, f"{impl} b{batch}: {n} tokens"
+        assert sched.flight.compiles_after_warmup_total == 0, (
+            f"post-warmup compiles: {sched.flight.post_warmup_keys}"
+        )
+        return sched, toks, round(n / max(wall, 1e-9), 1)
+
+    points = []
+    for batch in (8, 32):
+        s_f, t_f, rate_f = run("megakernel", batch, draft=False)
+        s_m, t_m, rate_m = run("gather", batch, draft=False)
+        assert s_f.flight.fused_sampled_windows_total > 0, (
+            "sampled traffic never reached the fused window"
+        )
+        launches = s_f.flight.fused_window_pallas_launches
+        assert launches == 1, (
+            f"fused sampled window traced {launches} pallas launches"
+        )
+        # Same request seeds through the fused epilogue and the sync
+        # sampler draw from the same (seed, position) threefry keys — the
+        # streams only agree where both paths consume identical uniforms,
+        # so cross-path we assert shape, and the parity tests
+        # (tests/test_megakernel.py) pin bit-identity per path.
+        row = {
+            "batch": batch,
+            "tok_s_sampled_fused": rate_f,
+            "tok_s_sampled_multi": rate_m,
+            "fused_sampled_windows": s_f.flight.fused_sampled_windows_total,
+            "fused_vs_multi": round(rate_f / max(rate_m, 1e-9), 3),
+        }
+
+        s_s, t_s, rate_s = run("megakernel", batch, draft=True)
+        assert s_s._use_fused_spec, "fused spec gate must engage"
+        assert s_s.flight.spec_fused_windows_total > 0
+        st = s_s.spec_stats.to_dict()
+        assert st["accepted_per_round"] >= 2.0, st
+        # Lossless-speculation gate: greedy through the fused spec window
+        # must emit the exact token stream plain greedy decoding does.
+        _, t_gold, _ = run("gather", batch, draft=False, greedy=True)
+        assert t_s == t_gold, "fused spec diverged from plain greedy"
+        row["tok_s_spec_fused"] = rate_s
+        row["spec_accepted_per_round"] = st["accepted_per_round"]
+        row["spec_fused_windows"] = s_s.flight.spec_fused_windows_total
+        points.append(row)
+
+    return {
+        "cpu_parity_mode": on_cpu,
+        "points": points,
+        "fused_window_pallas_launches": 1,
+        "note": "CPU: interpreter-mode Pallas — structural asserts "
+                "(sampled windows dispatch, 1 launch/window across all "
+                "fused variants, >=2 accepted tokens/spec round), not "
+                "speed. TPU rounds report the real tok/s deltas.",
+    }
+
+
 def bench_prefill(cfg, params, prompt_len):
     """One full prefill dispatch at the bucketed length → TTFT proxy."""
     import jax
@@ -2086,6 +2193,25 @@ def child_main() -> None:
     else:
         errors.append("guided_overhead skipped: budget")
 
+    # --- fused in-kernel sampling + spec window (CPU subprocess) ------------
+    fused_sampling = None
+    if remaining() > 60:
+        try:
+            fused_sampling, err = _run_cpu_subprocess(
+                [sys.executable, os.path.abspath(__file__)], "points",
+                max(60, remaining() - 10), extra_env={"BENCH_FUSED_SAMPLE_ONLY": "1"},
+            )
+            if fused_sampling is None:
+                errors.append(f"fused_sampling: {err}")
+            else:
+                _emit_partial("fused_sampling", fused_sampling)
+        except subprocess.TimeoutExpired:
+            errors.append("fused_sampling: subprocess timed out")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"fused_sampling: {type(e).__name__}: {e}")
+    else:
+        errors.append("fused_sampling skipped: budget")
+
     # --- closed-loop autoscaling (traffic harness, CPU subprocess) ----------
     autoscale = None
     if remaining() > 60:
@@ -2133,11 +2259,12 @@ def child_main() -> None:
                               decode_overlap=decode_overlap,
                               prefix_reuse=prefix_reuse,
                               decode_attention=decode_attention,
+                              fused_sampling=fused_sampling,
                               autoscale=autoscale, elastic=elastic,
                               device_truth=device_truth)), flush=True)
 
 
-def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None, autoscale=None, elastic=None, device_truth=None) -> dict:
+def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, errors, tpu_http=None, router_prefix=None, large_model=None, mixed_admission=None, observability=None, guided_overhead=None, decode_overlap=None, prefix_reuse=None, decode_attention=None, fused_sampling=None, autoscale=None, elastic=None, device_truth=None) -> dict:
     """Build the final JSON object from whatever sections completed."""
     hbm_gbps, _ = chip_peaks(device) if device else (None, None)
     best = max(decode_points, key=lambda p: p.get("achieved_hbm_gbps") or 0.0) if decode_points else None
@@ -2158,6 +2285,7 @@ def assemble(decode_points, prefill_detail, http, device, model, cpu_fallback, e
         "detail": {
             "decode_sweep": decode_points,
             "decode_attention": decode_attention,
+            "fused_sampling": fused_sampling,
             "prefill": prefill_detail,
             "tpu_http_e2e": tpu_http,
             "http_e2e": http,
@@ -2303,6 +2431,7 @@ def main() -> None:
             decode_overlap=partials.get("decode_overlap"),
             prefix_reuse=partials.get("prefix_reuse"),
             decode_attention=partials.get("decode_attention"),
+            fused_sampling=partials.get("fused_sampling"),
             autoscale=partials.get("autoscale"),
         )
     final["detail"]["errors"] = errors + final["detail"].get("errors", [])
@@ -2316,6 +2445,15 @@ if __name__ == "__main__":
         # parity + one-launch-per-window asserts; on TPU it reports the
         # gather vs megakernel roofline sweep).
         print(json.dumps(bench_decode_attention()), flush=True)
+    elif os.environ.get("BENCH_FUSED_SAMPLE_ONLY") == "1":
+        # CPU-pinned in CI: the subject is the fused window's in-kernel
+        # sampling epilogue + spec variant (structure + counters), not
+        # device speed — TPU rounds run it for the real tok/s deltas.
+        import jax
+
+        if jax.default_backend() != "tpu":
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_fused_sampling()), flush=True)
     elif os.environ.get("BENCH_PREFIX_ONLY") == "1":
         # CPU-pinned: the subject is skipped prefill FLOPs vs recompute in
         # the real scheduler, not device speed.

@@ -268,6 +268,13 @@ class FlightRecorder:
         # CI asserts it) and how many fused windows have been dispatched.
         self.fused_window_pallas_launches: Optional[int] = None
         self.fused_windows_total = 0
+        # In-kernel sampling + fused speculation: windows whose epilogue
+        # sampled on-chip (uniforms operand), and whole draft+verify spec
+        # windows with their accepted-token yield — the bench/Grafana
+        # accepted-tokens-per-window signal.
+        self.fused_sampled_windows_total = 0
+        self.spec_fused_windows_total = 0
+        self.spec_fused_accepted_tokens_total = 0
         # Compile tracker state.
         self._exec_keys: Set[tuple] = set()
         self.compiles_total = 0
@@ -508,9 +515,15 @@ class FlightRecorder:
         }
         if self.fused_windows_total or self.fused_window_pallas_launches is not None:
             out["fused_windows_total"] = self.fused_windows_total
+            out["fused_sampled_windows_total"] = self.fused_sampled_windows_total
             out["fused_window_pallas_launches"] = (
                 self.fused_window_pallas_launches
                 if self.fused_window_pallas_launches is not None else 0
+            )
+        if self.spec_fused_windows_total:
+            out["spec_fused_windows_total"] = self.spec_fused_windows_total
+            out["spec_fused_accepted_tokens_total"] = (
+                self.spec_fused_accepted_tokens_total
             )
         for phase, h in self._hists.items():
             if not h.total and phase not in ("prefill", "decode", "mixed"):

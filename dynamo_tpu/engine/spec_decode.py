@@ -41,20 +41,33 @@ class SpecDecodeStats:
     num_spec_tokens: int = 0  # total proposed
     num_accepted_tokens: int = 0
     num_draft_tokens: int = 0
-    num_rounds: int = 0
+    num_rounds: int = 0  # batch rounds (one per spec dispatch)
+    num_seq_rounds: int = 0  # per-row rounds (one per record_round call)
     # Per-position acceptance counts (how often position i of a proposal run
     # was accepted) — the reference exposes the same shape.
     accepted_per_position: List[int] = field(default_factory=list)
 
     @property
     def acceptance_rate(self) -> float:
+        """Accepted/proposed ratio; 0.0 (never NaN) for γ=0 rounds or a
+        zero-round history — the bench summary divides nothing by zero."""
         return self.num_accepted_tokens / self.num_draft_tokens if self.num_draft_tokens else 0.0
+
+    @property
+    def accepted_per_round(self) -> float:
+        """Mean tokens CONFIRMED per row-round including the correction/
+        bonus (the ≥2-accepted-tokens-per-step acceptance criterion reads
+        this); 0.0 (never NaN) for γ=0 or an empty history."""
+        if not self.num_seq_rounds:
+            return 0.0
+        return (self.num_accepted_tokens + self.num_seq_rounds) / self.num_seq_rounds
 
     def record_round(self, accepted: int, gamma: int) -> None:
         """Account one speculative round: γ proposed, ``accepted`` agreed."""
         self.num_draft_tokens += gamma
         self.num_spec_tokens += gamma
         self.num_accepted_tokens += accepted
+        self.num_seq_rounds += 1
         while len(self.accepted_per_position) < gamma:
             self.accepted_per_position.append(0)
         for i in range(accepted):
@@ -67,6 +80,7 @@ class SpecDecodeStats:
             "num_draft_tokens": self.num_draft_tokens,
             "num_rounds": self.num_rounds,
             "acceptance_rate": round(self.acceptance_rate, 4),
+            "accepted_per_round": round(self.accepted_per_round, 4),
             "accepted_per_position": self.accepted_per_position,
         }
 
@@ -227,26 +241,22 @@ def _filtered_probs(logits, temps, top_ks, top_ps):
     truncation + softmax. logits [B, S, V]; params [B] → probs [B, S, V].
     Greedy rows (temp 0) return a one-hot argmax distribution.
 
-    Thresholds come from sampling._exact_thresholds — the SAME math
-    sample_batch's exact path uses, so the draft's proposal distribution
-    and this verifier's p_d agree exactly (a divergence would bias the
-    rejection-sampled output distribution). Cost note: this is the
-    full-vocab-sort path (~ms at 128k vocab); a windowed variant like
-    sample_batch's SAMPLE_WINDOW fast path is a known optimization once
-    spec rounds show up in serving profiles."""
-    from dynamo_tpu.engine.sampling import _exact_thresholds
+    This is sampling.filtered_probs_rows — THE reference distribution the
+    host sampler, the fused window's in-kernel epilogue, and the fused spec
+    kernel all share — broadcast over the chunk axis, so the draft's
+    proposal distribution and this verifier's p_d agree bit-exactly (a
+    divergence would bias the rejection-sampled output distribution).
+    Cost note: this is the full-vocab-sort path (~ms at 128k vocab); a
+    windowed variant like sample_batch's SAMPLE_WINDOW fast path is a
+    known optimization once spec rounds show up in serving profiles."""
+    from dynamo_tpu.engine.sampling import filtered_probs_rows
 
     B, S, V = logits.shape
-    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None, None]
-    scaled = (logits / safe_t).reshape(B * S, V)
-    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
-    tk = jnp.repeat(top_ks, S)
-    tp = jnp.repeat(top_ps, S)
-    thresh = _exact_thresholds(scaled, lse, tk, tp)  # [B*S]
-    masked = jnp.where(scaled >= thresh[:, None], scaled, -jnp.inf)
-    probs = jax.nn.softmax(masked, axis=-1).reshape(B, S, V)
-    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), V, dtype=probs.dtype)
-    return jnp.where((temps > 0)[:, None, None], probs, greedy)
+    flat = filtered_probs_rows(
+        logits.reshape(B * S, V), jnp.repeat(temps, S),
+        jnp.repeat(top_ks, S), jnp.repeat(top_ps, S),
+    )
+    return flat.reshape(B, S, V)
 
 
 def spec_verify(

@@ -122,8 +122,10 @@ COUNTER_KEYS = (
     "step_spec_flops_total", "step_spec_bytes_total",
     # Stall watchdog transitions (each is one wedged-engine incident).
     "engine_stalls_total",
-    # Fused megakernel decode windows dispatched (one pallas launch each).
-    "fused_windows_total",
+    # Fused megakernel decode windows dispatched (one pallas launch each),
+    # plus the sampled-epilogue and speculative variants of that window.
+    "fused_windows_total", "fused_sampled_windows_total",
+    "spec_fused_windows_total", "spec_fused_accepted_tokens_total",
     # Incident autopsy plane (runtime/incidents.py): anomaly-triggered
     # black-box captures, total and per trigger reason, plus on-demand /
     # per-incident device-profile captures.

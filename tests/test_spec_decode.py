@@ -71,3 +71,51 @@ def test_spec_stats_dict():
     s = SpecDecodeStats(num_spec_tokens=8, num_accepted_tokens=6, num_draft_tokens=8, num_rounds=2)
     d = s.to_dict()
     assert d["acceptance_rate"] == 0.75
+
+
+def test_spec_stats_zero_round_guards():
+    """A fresh (zero-round) history yields 0.0 everywhere — never NaN/ZeroDiv
+    — and to_dict round-trips the guarded values."""
+    s = SpecDecodeStats()
+    assert s.acceptance_rate == 0.0
+    assert s.accepted_per_round == 0.0
+    d = s.to_dict()
+    assert d["acceptance_rate"] == 0.0
+    assert d["accepted_per_round"] == 0.0
+    assert d["accepted_per_position"] == []
+
+
+def test_spec_stats_gamma_zero_rounds():
+    """γ=0 rounds propose nothing: acceptance_rate stays 0.0 (no draft
+    tokens to divide by) but accepted_per_round still counts the bonus
+    token every round emits."""
+    s = SpecDecodeStats()
+    s.record_round(0, 0)
+    s.record_round(0, 0)
+    assert s.num_draft_tokens == 0
+    assert s.acceptance_rate == 0.0
+    assert s.accepted_per_round == 1.0  # bonus/correction token per round
+    assert np.isfinite(s.to_dict()["accepted_per_round"])
+
+
+def test_spec_stats_all_rejected():
+    """Every proposal rejected: rate 0.0, but each round still confirms the
+    verifier's correction token, so accepted_per_round == 1.0 (the fused
+    window's worst case is target-only speed, not zero progress)."""
+    s = SpecDecodeStats()
+    for _ in range(4):
+        s.record_round(0, 3)
+    assert s.num_draft_tokens == 12
+    assert s.acceptance_rate == 0.0
+    assert s.accepted_per_round == 1.0
+    assert s.accepted_per_position == [0, 0, 0]
+
+
+def test_spec_stats_accepted_per_round_mixed():
+    """Mixed accept counts across rows/rounds: (accepted + rounds) / rounds
+    — e.g. k=3,1,2 over 3 row-rounds confirms (6+3)/3 = 3 tokens/round."""
+    s = SpecDecodeStats()
+    for k in (3, 1, 2):
+        s.record_round(k, 3)
+    assert s.accepted_per_round == 3.0
+    assert s.to_dict()["accepted_per_round"] == 3.0
